@@ -1,0 +1,35 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+This is the "fake backend" the reference never had (SURVEY.md §4): XLA's
+host-platform device-count flag gives 8 independent CPU devices, so every
+mesh/sharding/collective path is exercised without TPU hardware. Must run
+before jax is imported anywhere.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# Force CPU: the session env may pin JAX_PLATFORMS to a real TPU backend,
+# but the test suite always runs on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+# Determinism and small-host friendliness.
+os.environ.setdefault("TPUDIST_TEST", "1")
+
+import jax  # noqa: E402
+
+# A site hook may have imported jax at interpreter start and pinned a
+# hardware platform; the config-level override still wins as long as no
+# backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual devices, got {len(devs)}"
+    return devs

@@ -1,0 +1,64 @@
+"""Data pipeline: determinism, separability, sharding contract
+(reference behaviors: train.py:19-24 seeding, 63-74 sampler)."""
+
+import numpy as np
+import pytest
+
+from tpudist import data
+
+
+def test_synthetic_data_deterministic():
+    x1, y1 = data.make_synthetic_data(200, 20, seed=42)
+    x2, y2 = data.make_synthetic_data(200, 20, seed=42)
+    np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    x3, _ = data.make_synthetic_data(200, 20, seed=7)
+    assert not np.array_equal(np.asarray(x1), np.asarray(x3))
+
+
+def test_synthetic_data_linearly_separable():
+    x, y = data.make_synthetic_data(500, 20, seed=42)
+    x, y = np.asarray(x), np.asarray(y)
+    # label is exactly 1[sum of first 10 features > 0]
+    expect = (x[:, :10].sum(axis=1) > 0).astype(np.float32)
+    np.testing.assert_array_equal(y, expect)
+    assert 0.2 < y.mean() < 0.8  # both classes present
+
+
+def test_shard_epoch_partitions_global_batch():
+    x, y = data.make_synthetic_data(256, 20, seed=0)
+    shards = [data.shard_epoch(x, y, batch_size=64, seed=1, epoch=3,
+                               process_index=i, process_count=4)
+              for i in range(4)]
+    # each process: (steps=4, local=16, feat)
+    for bx, by in shards:
+        assert bx.shape == (4, 16, 20)
+        assert by.shape == (4, 16)
+    # concatenated shards of step 0 == global batch 0 of the permutation
+    perm = data.epoch_permutation(1, 3, 256)
+    got = np.concatenate([np.asarray(s[0][0]) for s in shards], axis=0)
+    np.testing.assert_array_equal(got, np.asarray(x)[perm[:64]])
+
+
+def test_shard_epoch_epochs_differ_but_are_deterministic():
+    x, y = data.make_synthetic_data(128, 20, seed=0)
+    a0, _ = data.shard_epoch(x, y, batch_size=32, seed=5, epoch=0)
+    a0b, _ = data.shard_epoch(x, y, batch_size=32, seed=5, epoch=0)
+    a1, _ = data.shard_epoch(x, y, batch_size=32, seed=5, epoch=1)
+    np.testing.assert_array_equal(np.asarray(a0), np.asarray(a0b))
+    assert not np.array_equal(np.asarray(a0), np.asarray(a1))
+
+
+def test_shard_epoch_rejects_bad_divisibility():
+    x, y = data.make_synthetic_data(64, 20, seed=0)
+    with pytest.raises(ValueError):
+        data.shard_epoch(x, y, batch_size=30, seed=0, epoch=0,
+                         process_index=0, process_count=4)
+    with pytest.raises(ValueError):
+        data.shard_epoch(x, y, batch_size=128, seed=0, epoch=0)
+
+
+def test_synthetic_tokens_learnable_structure():
+    toks = np.asarray(data.make_synthetic_tokens(4, 16, 97, seed=0))
+    assert toks.shape == (4, 16)
+    np.testing.assert_array_equal(toks[:, 1:], (toks[:, :-1] * 7 + 3) % 97)

@@ -1,0 +1,96 @@
+"""Engine: convergence single-device, DP-vs-single agreement, grad accum.
+
+The convergence test is the TPU-native version of the reference's only test
+(the job itself, SURVEY.md §4): seeded linearly-separable data ⇒ loss must
+fall fast, deterministically.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist import data, engine
+from tpudist.config import DataConfig, ModelConfig, ParallelConfig, TrainConfig
+from tpudist.parallel import build_mesh
+
+
+def _cfg(**kw):
+    base = dict(batch_size=64, epochs=1, lr=1e-2, seed=42,
+                data=DataConfig(n_samples=512),
+                parallel=ParallelConfig(data=-1))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _run_epochs(cfg, mesh, n_epochs=2):
+    x, y = data.make_synthetic_data(cfg.data.n_samples, cfg.data.n_features,
+                                    cfg.data.seed)
+    state = engine.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    step = engine.make_train_step(cfg, mesh)
+    losses = []
+    for epoch in range(n_epochs):
+        bx, by = data.shard_epoch(x, y, batch_size=cfg.batch_size,
+                                  seed=cfg.seed, epoch=epoch)
+        for i in range(bx.shape[0]):
+            state, loss = step(state, (bx[i], by[i]))
+            losses.append(float(loss))
+    return state, losses
+
+
+def test_single_device_convergence():
+    """Single-process mode is first-class (the reference crashed here,
+    SURVEY.md §3.2)."""
+    cfg = _cfg(parallel=ParallelConfig(data=1))
+    mesh = build_mesh(cfg.parallel, devices=jax.devices()[:1])
+    _, losses = _run_epochs(cfg, mesh, n_epochs=3)
+    assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+
+
+def test_dp8_convergence_and_matches_single_device(devices8):
+    cfg = _cfg()
+    mesh8 = build_mesh(cfg.parallel, devices=devices8)
+    mesh1 = build_mesh(ParallelConfig(data=1), devices=devices8[:1])
+    s8, l8 = _run_epochs(cfg, mesh8, n_epochs=2)
+    s1, l1 = _run_epochs(cfg, mesh1, n_epochs=2)
+    # Same global batches, same math → same trajectory (tolerance for
+    # reduction-order differences across 8 shards).
+    np.testing.assert_allclose(l8, l1, rtol=2e-3, atol=2e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+        s8.params, s1.params)
+
+
+def test_step_counter_increments(devices8):
+    cfg = _cfg()
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state, _ = _run_epochs(cfg, mesh, n_epochs=1)
+    assert int(state.step) == cfg.data.n_samples // cfg.batch_size
+
+
+def test_grad_accum_matches_big_batch(devices8):
+    """2 microbatches of 32 == 1 batch of 64, same update."""
+    mesh = build_mesh(ParallelConfig(data=1), devices=jax.devices()[:1])
+    cfg1 = _cfg(grad_accum_steps=1)
+    cfg2 = _cfg(grad_accum_steps=2)
+    x, y = data.make_synthetic_data(64, 20, 0)
+    s1 = engine.init_state(jax.random.PRNGKey(0), cfg1, mesh)
+    s2 = engine.init_state(jax.random.PRNGKey(0), cfg2, mesh)
+    st1 = engine.make_train_step(cfg1, mesh)
+    st2 = engine.make_train_step(cfg2, mesh)
+    s1, l1 = st1(s1, (x, y))
+    s2, l2 = st2(s2, (x, y))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
+        s1.params, s2.params)
+
+
+def test_bfloat16_compute_converges():
+    cfg = _cfg(dtype="bfloat16", parallel=ParallelConfig(data=1))
+    mesh = build_mesh(cfg.parallel, devices=jax.devices()[:1])
+    _, losses = _run_epochs(cfg, mesh, n_epochs=3)
+    assert losses[-1] < 0.5 * losses[0]

@@ -1,0 +1,27 @@
+"""Mesh construction and the topology-probe contract (analogue of the
+reference CI's scontrol probe + sed patch, ci:115-119)."""
+
+import pytest
+
+from tpudist.config import ParallelConfig
+from tpudist.parallel import build_mesh, resolve_axis_sizes
+
+
+def test_resolve_infers_data_axis():
+    assert resolve_axis_sizes(ParallelConfig(), 8) == (8, 1, 1, 1)
+    assert resolve_axis_sizes(ParallelConfig(fsdp=4), 8) == (2, 4, 1, 1)
+    assert resolve_axis_sizes(ParallelConfig(fsdp=2, tensor=2), 8) \
+        == (2, 2, 2, 1)
+
+
+def test_resolve_rejects_bad_factorisation():
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(ParallelConfig(fsdp=3), 8)
+    with pytest.raises(ValueError):
+        resolve_axis_sizes(ParallelConfig(data=4, fsdp=4), 8)
+
+
+def test_build_mesh_axes(devices8):
+    mesh = build_mesh(ParallelConfig(fsdp=2), devices=devices8)
+    assert mesh.axis_names == ("data", "fsdp", "tensor", "context")
+    assert mesh.devices.shape == (4, 2, 1, 1)

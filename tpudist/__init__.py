@@ -1,0 +1,19 @@
+"""tpudist — a TPU-native distributed-training acceptance-test framework.
+
+Built from scratch with the capabilities of the reference GPU-cluster
+acceptance test (``dashabalashova/distributed-gpu-test``), re-designed
+TPU-first: synthetic-data training workloads expressed as pure-JAX pytrees,
+data/FSDP/tensor/context parallelism via ``jax.sharding.Mesh`` + ``shard_map``
+/ ``pjit`` with XLA collectives over ICI/DCN, orbax checkpointing, and a
+measured collective-bandwidth harness.
+
+Layer map (mirrors SURVEY.md §1, each layer rebuilt idiomatically):
+  L1 workload   -> tpudist.train / tpudist.engine / tpudist.models
+  L2 container  -> docker/Dockerfile (TPU-VM image, zero CUDA)
+  L3 launcher   -> launcher/ (gcloud TPU queued-resources, replaces sbatch)
+  L4 CI         -> .github/workflows/tpu-test-ci.yaml
+"""
+
+from tpudist.version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,57 @@
+"""Checkpoint / resume via orbax.
+
+Reference counterpart: per-epoch ``model_engine.save_checkpoint(save_dir/
+epochN)`` (reference ``train.py:123-125``) — write-only, no load path, no
+retention (SURVEY.md §5.4). Here: orbax ``CheckpointManager`` keyed by epoch,
+sharding-aware (saves/restores FSDP-sharded state without gathering),
+multi-host coordinated, with resume (``restore_latest``) and a retention
+policy — the cheap wins the reference skipped.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+DEFAULT_KEEP = 3
+
+
+def _manager(save_dir: str, keep: Optional[int] = DEFAULT_KEEP
+             ) -> ocp.CheckpointManager:
+    return ocp.CheckpointManager(
+        os.path.abspath(os.path.expanduser(save_dir)),
+        options=ocp.CheckpointManagerOptions(
+            max_to_keep=keep, create=True, enable_async_checkpointing=False))
+
+
+def save(save_dir: str, state: Any, *, epoch: int,
+         keep: Optional[int] = DEFAULT_KEEP) -> None:
+    """Save TrainState for an epoch. All processes call this (orbax
+    coordinates the multi-host write — the analogue of every rank calling
+    save_checkpoint at reference train.py:125, minus the redundant copies)."""
+    mgr = _manager(save_dir, keep)
+    mgr.save(epoch, args=ocp.args.StandardSave(state))
+    mgr.wait_until_finished()
+    mgr.close()
+
+
+def restore_latest(save_dir: str, template: Any
+                   ) -> Optional[Tuple[Any, int]]:
+    """Restore the newest checkpoint as (state, next_epoch), or None if the
+    directory holds none. ``template`` (a concretely-sharded TrainState)
+    pins shardings/dtypes so restoration lands directly in the FSDP layout."""
+    path = os.path.abspath(os.path.expanduser(save_dir))
+    if not os.path.isdir(path):
+        return None
+    mgr = _manager(save_dir, None)
+    step = mgr.latest_step()
+    if step is None:
+        mgr.close()
+        return None
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+    mgr.close()
+    return state, step + 1

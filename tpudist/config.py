@@ -1,0 +1,138 @@
+"""Configuration system for the workload.
+
+The reference scattered configuration over four ad-hoc surfaces with broken
+precedence (three conflicting batch sizes — reference ``train.py:44,74,79``,
+SURVEY.md §2.7). Here there is exactly ONE config object with explicit
+precedence: defaults < CLI flags. The CLI remains tolerant of unknown flags
+for parity with the reference's ``parse_known_args`` contract
+(reference ``train.py:49``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+
+@dataclass(frozen=True)
+class DataConfig:
+    """Synthetic dataset shape (parity: reference ``train.py:19-24,63``)."""
+
+    n_samples: int = 2000
+    n_features: int = 20
+    seed: int = 42
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    """Model selection. ``mlp`` is the parity model (reference
+    ``train.py:26-36``); ``transformer`` is the north-star synthetic
+    Llama-block model (BASELINE.json config #5)."""
+
+    name: str = "mlp"
+    n_features: int = 20
+    hidden: int = 64
+    # transformer-only fields
+    vocab_size: int = 32000
+    n_layers: int = 4
+    d_model: int = 2048
+    n_heads: int = 16
+    n_kv_heads: int = 16
+    d_ff: int = 5504
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+
+
+@dataclass(frozen=True)
+class ParallelConfig:
+    """Mesh axis sizes. ``-1`` on the data axis means "all remaining
+    devices". A size of 1 disables that axis (it still exists in the mesh so
+    shardings are uniform across configurations)."""
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Top-level workload config (parity: reference ``train.py:42-49`` flags
+    plus the ds_config dict at ``train.py:78-83``, unified)."""
+
+    batch_size: int = 64          # GLOBAL batch size (one source of truth)
+    epochs: int = 5
+    lr: float = 1e-3
+    seed: int = 42
+    save_dir: str = "ckpt"
+    resume: bool = False
+    grad_accum_steps: int = 1
+    dtype: str = "float32"        # compute dtype: float32 | bfloat16
+    fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
+    log_every: int = 100
+    data: DataConfig = dataclasses.field(default_factory=DataConfig)
+    model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
+    parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+
+
+def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
+    """CLI → TrainConfig. Unknown flags are tolerated (parity with the
+    reference's ``parse_known_args()[0]``), so launchers may pass extra
+    flags without breaking the workload."""
+    p = argparse.ArgumentParser(description="tpudist synthetic training workload")
+    p.add_argument("--train-batch-size", type=int, default=64,
+                   help="global batch size across all data-parallel replicas")
+    p.add_argument("--epochs", type=int, default=5)
+    p.add_argument("--lr", type=float, default=1e-3)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--save-dir", type=str, default="ckpt")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --save-dir")
+    p.add_argument("--model", type=str, default="mlp",
+                   choices=["mlp", "transformer"])
+    p.add_argument("--dtype", type=str, default="float32",
+                   choices=["float32", "bfloat16"])
+    p.add_argument("--grad-accum-steps", type=int, default=1)
+    p.add_argument("--n-samples", type=int, default=2000)
+    p.add_argument("--n-features", type=int, default=20)
+    # transformer shape (defaults = BASELINE.json config #5: 4 layers, 2k hidden)
+    p.add_argument("--vocab-size", type=int, default=32000)
+    p.add_argument("--n-layers", type=int, default=4)
+    p.add_argument("--d-model", type=int, default=2048)
+    p.add_argument("--n-heads", type=int, default=16)
+    p.add_argument("--n-kv-heads", type=int, default=None)
+    p.add_argument("--d-ff", type=int, default=5504)
+    p.add_argument("--seq-len", type=int, default=2048)
+    p.add_argument("--fsdp", type=int, default=1, help="fsdp mesh axis size")
+    p.add_argument("--tensor", type=int, default=1, help="tensor mesh axis size")
+    p.add_argument("--context", type=int, default=1, help="context mesh axis size")
+    p.add_argument("--fail-at", type=int, default=None,
+                   help="fault injection: fail after this epoch (replaces the "
+                        "reference's commented-out sys.exit(1), train.py:129)")
+    p.add_argument("--log-every", type=int, default=100)
+    args = p.parse_known_args(argv)[0]
+
+    return TrainConfig(
+        batch_size=args.train_batch_size,
+        epochs=args.epochs,
+        lr=args.lr,
+        seed=args.seed,
+        save_dir=args.save_dir,
+        resume=args.resume,
+        grad_accum_steps=args.grad_accum_steps,
+        dtype=args.dtype,
+        fail_at=args.fail_at,
+        log_every=args.log_every,
+        data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
+                        seed=args.seed),
+        model=ModelConfig(name=args.model, n_features=args.n_features,
+                          vocab_size=args.vocab_size, n_layers=args.n_layers,
+                          d_model=args.d_model, n_heads=args.n_heads,
+                          n_kv_heads=(args.n_kv_heads if args.n_kv_heads
+                                      is not None else args.n_heads),
+                          d_ff=args.d_ff, max_seq_len=args.seq_len),
+        parallel=ParallelConfig(fsdp=args.fsdp, tensor=args.tensor,
+                                context=args.context),
+    )

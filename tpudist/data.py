@@ -1,0 +1,93 @@
+"""Deterministic synthetic data + sharded batching.
+
+Parity target: reference ``train.py:19-24`` (``make_synthetic_data``) and the
+``DistributedSampler`` + ``DataLoader`` pipeline at ``train.py:63-74``.
+
+TPU-first differences:
+  * jax PRNG keys instead of a global torch seed — determinism is explicit
+    and independent of call order.
+  * The "sampler" is a pure function producing a permutation from
+    ``(seed, epoch)``; every process computes the SAME global permutation and
+    slices out its own shard by ``process_index`` — no inter-process
+    coordination needed (the reference needed ``sampler.set_epoch`` state).
+  * Batches are materialised as a single ``(steps, batch, ...)`` array so the
+    epoch can run under ``lax.scan`` with static shapes (XLA-friendly), rather
+    than a Python DataLoader yielding tensors one at a time.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def make_synthetic_data(n_samples: int = 2000, n_features: int = 20,
+                        seed: int = 42) -> Tuple[jax.Array, jax.Array]:
+    """Linearly separable binary task: ``y = 1[sum of first n_features//2
+    columns > 0]`` on ``x ~ N(0, 1)``.
+
+    Deterministic by seed — this is the convergence oracle (loss must fall
+    fast), matching reference ``train.py:19-24``.
+    """
+    key = jax.random.PRNGKey(seed)
+    x = jax.random.normal(key, (n_samples, n_features), dtype=jnp.float32)
+    y = (jnp.sum(x[:, : n_features // 2], axis=1) > 0).astype(jnp.float32)
+    return x, y
+
+
+def make_synthetic_tokens(n_samples: int, seq_len: int, vocab_size: int,
+                          seed: int = 42) -> jax.Array:
+    """Synthetic token stream for the transformer workload (BASELINE.json
+    config #5). Deterministic next-token structure: token[t+1] depends on
+    token[t] via a fixed affine map mod vocab, so a causal LM can learn it
+    and loss decreases — the sequence-shaped convergence oracle."""
+    rng = np.random.default_rng(seed)
+    first = rng.integers(0, vocab_size, size=(n_samples, 1), dtype=np.int32)
+    toks = np.empty((n_samples, seq_len), dtype=np.int32)
+    toks[:, :1] = first
+    for t in range(1, seq_len):
+        toks[:, t] = (toks[:, t - 1] * 7 + 3) % vocab_size
+    return jnp.asarray(toks)
+
+
+def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
+    """Global shuffle for an epoch, identical on every process.
+
+    Replaces ``DistributedSampler(shuffle=True)`` + ``set_epoch`` (reference
+    ``train.py:68-69,101``): the permutation is a pure function of
+    ``(seed, epoch)`` so no state or broadcast is required.
+    """
+    return np.asarray(jax.random.permutation(
+        jax.random.fold_in(jax.random.PRNGKey(seed), epoch), n))
+
+
+def shard_epoch(x: jax.Array, y: jax.Array, *, batch_size: int, seed: int,
+                epoch: int, process_index: int = 0,
+                process_count: int = 1) -> Tuple[jax.Array, jax.Array]:
+    """Produce this process's batches for one epoch.
+
+    Returns ``(steps, local_batch, ...)`` arrays where
+    ``local_batch = batch_size // process_count``. ``batch_size`` is the
+    GLOBAL batch size (fixing the reference's three-way batch-size conflict,
+    SURVEY.md §2.7). Trailing samples that don't fill a global batch are
+    dropped (static shapes for XLA).
+    """
+    n = x.shape[0]
+    if batch_size % process_count:
+        raise ValueError(
+            f"global batch_size={batch_size} not divisible by "
+            f"process_count={process_count}")
+    local_bs = batch_size // process_count
+    steps = n // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"n_samples={n} < global batch_size={batch_size}: zero steps")
+    perm = epoch_permutation(seed, epoch, n)[: steps * batch_size]
+    # Global batch b is perm[b*batch_size:(b+1)*batch_size]; this process owns
+    # the contiguous slice [process_index*local_bs : (process_index+1)*local_bs)
+    # of every global batch — the DistributedSampler-equivalent contract.
+    idx = perm.reshape(steps, process_count, local_bs)[:, process_index, :]
+    return x[idx], y[idx]

@@ -1,0 +1,99 @@
+"""Observability: step timing, throughput, rank-0 structured logging.
+
+The reference had NO timing at all (SURVEY.md §5.1 — its only clock was CI's
+10-second job poll) and print-only logging (§5.5). Here: a StepTimer with
+proper ``block_until_ready`` fencing (XLA is async — wall-clocking a
+dispatched-but-unfinished step measures nothing), steps/sec/chip (the
+BASELINE.json headline metric), and JSONL metrics next to the human log.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+from typing import IO, Any, Dict, List, Optional
+
+import jax
+
+
+def log0(msg: str) -> None:
+    """Rank-0-gated print (parity: reference ``train.py:120-121,128``)."""
+    if jax.process_index() == 0:
+        print(msg, flush=True)
+
+
+@dataclass
+class StepTimer:
+    """Wall-clock over completed device work.
+
+    ``stop(result)`` blocks on ``result`` before reading the clock so the
+    measurement covers actual execution, not async dispatch. The first
+    ``warmup`` stops (default 1: the trace+compile step) are excluded from
+    the throughput aggregate — compile time would otherwise dominate short
+    runs and corrupt the steps/sec headline metric.
+    """
+    warmup: int = 1
+    t0: float = 0.0
+    elapsed: float = 0.0
+    steps: int = 0
+    warmup_s: float = 0.0
+    _seen: int = 0
+
+    def start(self) -> None:
+        self.t0 = time.perf_counter()
+
+    def stop(self, result: Any = None) -> float:
+        if result is not None:
+            jax.block_until_ready(result)
+        dt = time.perf_counter() - self.t0
+        self._seen += 1
+        if self._seen <= self.warmup:
+            self.warmup_s += dt
+        else:
+            self.elapsed += dt
+            self.steps += 1
+        return dt
+
+    def steps_per_sec(self) -> float:
+        return self.steps / self.elapsed if self.elapsed > 0 else 0.0
+
+    def steps_per_sec_per_chip(self) -> float:
+        return self.steps_per_sec() / max(jax.device_count(), 1)
+
+
+@dataclass
+class MetricsLogger:
+    """JSONL metrics stream, rank-0 only (structured logging the reference
+    lacked — its observability was stdout through SLURM log files,
+    SURVEY.md §5.5)."""
+    path: Optional[str] = None
+    _fh: Optional[IO] = None
+    history: List[Dict] = field(default_factory=list)
+
+    def log(self, **kv) -> None:
+        if jax.process_index() != 0:
+            return
+        rec = dict(ts=time.time(), **kv)
+        self.history.append(rec)
+        if self.path:
+            if self._fh is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+def device_kind() -> str:
+    try:
+        return jax.devices()[0].device_kind
+    except Exception:
+        return "unknown"

@@ -1,0 +1,66 @@
+"""2-layer MLP binary classifier — the parity workload model.
+
+Reference: ``SimpleNet`` at ``train.py:26-36`` (Linear(20,64) → ReLU →
+Linear(64,1)). Here it's a params pytree + pure ``apply`` so the same code
+runs under ``jit``, ``shard_map``, and any sharding without wrappers.
+Init matches torch's Linear default (Kaiming-uniform-ish fan-in bound) in
+spirit; exact torch bit-parity is not a goal — the convergence oracle is.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from tpudist.config import ModelConfig
+
+Params = Dict[str, Dict[str, jax.Array]]
+
+
+def _linear_init(key: jax.Array, fan_in: int, fan_out: int):
+    kw, kb = jax.random.split(key)
+    bound = 1.0 / jnp.sqrt(fan_in)
+    w = jax.random.uniform(kw, (fan_in, fan_out), jnp.float32, -bound, bound)
+    b = jax.random.uniform(kb, (fan_out,), jnp.float32, -bound, bound)
+    return {"w": w, "b": b}
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    k1, k2 = jax.random.split(key)
+    return {
+        "fc1": _linear_init(k1, cfg.n_features, cfg.hidden),
+        "fc2": _linear_init(k2, cfg.hidden, 1),
+    }
+
+
+def apply(params: Params, x: jax.Array) -> jax.Array:
+    """Forward: logits of shape (batch,). Compute dtype follows x."""
+    dt = x.dtype
+    h = x @ params["fc1"]["w"].astype(dt) + params["fc1"]["b"].astype(dt)
+    h = jax.nn.relu(h)
+    out = h @ params["fc2"]["w"].astype(dt) + params["fc2"]["b"].astype(dt)
+    return out[..., 0]
+
+
+def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
+                tensor_axis: str = "tensor") -> Params:
+    """PartitionSpecs: FSDP shards the hidden dim of fc1/fc2 weights.
+    The MLP is too small for tensor parallelism to matter; the tensor axis is
+    unused here (transformer uses it)."""
+    del tensor_axis
+    return {
+        "fc1": {"w": P(None, fsdp_axis), "b": P(fsdp_axis)},
+        "fc2": {"w": P(fsdp_axis, None), "b": P(None)},
+    }
+
+
+def loss_fn(params: Params, batch, *, dtype=jnp.float32) -> jax.Array:
+    """Mean BCE-with-logits (parity: reference ``train.py:96,112``)."""
+    x, y = batch
+    logits = apply(params, x.astype(dtype)).astype(jnp.float32)
+    # numerically stable BCE with logits
+    return jnp.mean(jnp.maximum(logits, 0) - logits * y +
+                    jnp.log1p(jnp.exp(-jnp.abs(logits))))

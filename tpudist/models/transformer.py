@@ -1,0 +1,191 @@
+"""Synthetic Llama-style transformer block stack (BASELINE.json config #5).
+
+The reference has no sequence-shaped model at all (its model is a 20-feature
+MLP, reference ``train.py:26-36``); this is the north-star extension: a
+4-layer / 2048-hidden decoder with RMSNorm, RoPE, SwiGLU — shaped so the
+FLOPs land on the MXU (all dims multiples of 128, bf16-friendly).
+
+Sharding design (scaling-book recipe — annotate, let XLA insert collectives):
+  * tensor axis: attention heads and the FFN hidden dim are sharded column-
+    then row-wise (Megatron layout) purely via PartitionSpecs — the SPMD
+    partitioner inserts the psums, no manual collectives.
+  * fsdp axis: every weight's first (non-tensor-sharded) dim is sharded;
+    XLA all-gathers weights per layer and reduce-scatters grads.
+  * context axis: sequence dim of activations; attention runs as ring
+    attention (tpudist.ops.ring_attention) when the axis is >1.
+
+Stacked-layer params use a leading ``n_layers`` dim and the forward uses
+``lax.scan`` over layers — one compiled layer body regardless of depth
+(fast compiles, XLA-friendly).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpudist.config import ModelConfig
+
+Params = Dict
+
+
+def precompute_rope(seq_len: int, head_dim: int, theta: float = 10000.0,
+                    offset: int = 0):
+    """RoPE cos/sin tables of shape (seq_len, head_dim//2), f32."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
+                                           dtype=jnp.float32) / head_dim))
+    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x: jax.Array, cos: jax.Array, sin: jax.Array) -> jax.Array:
+    """x: (..., seq, heads, head_dim). Rotates pairs (even, odd) channels."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[None, :, None, :].astype(x.dtype)
+    s = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def rmsnorm(x: jax.Array, g: jax.Array, eps: float = 1e-6) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * g.astype(x.dtype)
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    """Params pytree. Per-layer weights are stacked on a leading n_layers dim
+    so the forward can lax.scan over them."""
+    d, h, kv, dff, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff,
+                        cfg.n_layers)
+    hd = d // h
+    keys = jax.random.split(key, 8)
+
+    def norm_init(*shape):
+        return jnp.ones(shape, jnp.float32)
+
+    def w(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / jnp.sqrt(fan_in)))
+
+    return {
+        "embed": w(keys[0], cfg.vocab_size, d, fan_in=d),  # also output head
+        "layers": {
+            "attn_norm": norm_init(L, d),
+            "wq": w(keys[1], L, d, h * hd, fan_in=d),
+            "wk": w(keys[2], L, d, kv * hd, fan_in=d),
+            "wv": w(keys[3], L, d, kv * hd, fan_in=d),
+            "wo": w(keys[4], L, h * hd, d, fan_in=h * hd),
+            "ffn_norm": norm_init(L, d),
+            "w_gate": w(keys[5], L, d, dff, fan_in=d),
+            "w_up": w(keys[6], L, d, dff, fan_in=d),
+            "w_down": w(keys[7], L, dff, d, fan_in=dff),
+        },
+        "final_norm": norm_init(d),
+    }
+
+
+def _attention(q, k, v, *, causal: bool = True):
+    """Plain causal attention. q,k,v: (batch, seq, heads, head_dim).
+    Ring/context-parallel execution swaps this for
+    tpudist.ops.ring_attention at the shard_map level."""
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+    if causal:
+        s_q, s_k = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        scores = jnp.where(mask, scores, jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
+    """One decoder layer. x: (batch, seq, d_model)."""
+    b, s, d = x.shape
+    h, kv = cfg.n_heads, cfg.n_kv_heads
+    hd = d // h
+    dt = x.dtype
+
+    y = rmsnorm(x, lp["attn_norm"])
+    q = (y @ lp["wq"].astype(dt)).reshape(b, s, h, hd)
+    k = (y @ lp["wk"].astype(dt)).reshape(b, s, kv, hd)
+    v = (y @ lp["wv"].astype(dt)).reshape(b, s, kv, hd)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    if kv != h:  # grouped-query attention: repeat kv heads
+        rep = h // kv
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    o = attn_impl(q, k, v).reshape(b, s, h * hd)
+    x = x + o @ lp["wo"].astype(dt)
+
+    y = rmsnorm(x, lp["ffn_norm"])
+    gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
+    up = y @ lp["w_up"].astype(dt)
+    x = x + (gate * up) @ lp["w_down"].astype(dt)
+    return x
+
+
+def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+          dtype=jnp.bfloat16, attn_impl=_attention,
+          rope_offset: int = 0) -> jax.Array:
+    """Forward: tokens (batch, seq) int32 -> logits (batch, seq, vocab) f32.
+
+    ``attn_impl`` lets context-parallel callers substitute ring attention;
+    ``rope_offset`` gives each context shard its absolute positions.
+    """
+    s = tokens.shape[1]
+    hd = cfg.d_model // cfg.n_heads
+    cos, sin = precompute_rope(s, hd, cfg.rope_theta, offset=rope_offset)
+    x = params["embed"].astype(dtype)[tokens]
+
+    def body(x, lp):
+        return _layer(x, lp, cfg, cos, sin, attn_impl), None
+
+    x, _ = lax.scan(body, x, params["layers"])
+    x = rmsnorm(x, params["final_norm"])
+    # tied output head
+    return (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+
+
+def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
+                tensor_axis: str = "tensor") -> Params:
+    """Megatron-style tensor sharding + FSDP on the other dim.
+
+    Column-parallel (shard output dim on tensor): wq/wk/wv/w_gate/w_up.
+    Row-parallel (shard input dim on tensor): wo/w_down.
+    Embedding: vocab dim on fsdp, model dim on tensor (tied head makes the
+    output projection row-parallel → psum inserted by XLA).
+    Leading layer dim of stacked weights is never sharded.
+    """
+    f, t = fsdp_axis, tensor_axis
+    return {
+        "embed": P(f, t),
+        "layers": {
+            "attn_norm": P(None, None),
+            "wq": P(None, f, t),
+            "wk": P(None, f, t),
+            "wv": P(None, f, t),
+            "wo": P(None, t, f),
+            "ffn_norm": P(None, None),
+            "w_gate": P(None, f, t),
+            "w_up": P(None, f, t),
+            "w_down": P(None, t, f),
+        },
+        "final_norm": P(None),
+    }
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+            dtype=jnp.bfloat16) -> jax.Array:
+    """Causal next-token cross-entropy over the synthetic token stream."""
+    logits = apply(params, tokens[:, :-1], cfg, dtype=dtype)
+    targets = tokens[:, 1:]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)

@@ -1,0 +1,8 @@
+from tpudist.parallel.mesh import MeshAxes, build_mesh, resolve_axis_sizes
+from tpudist.parallel.distributed import (DistContext, initialize,
+                                          process_shard_info)
+
+__all__ = [
+    "MeshAxes", "build_mesh", "resolve_axis_sizes",
+    "DistContext", "initialize", "process_shard_info",
+]

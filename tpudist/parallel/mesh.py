@@ -1,0 +1,75 @@
+"""Device mesh construction.
+
+The reference had no mesh concept — its only topology was "one process per
+GPU, NCCL flat world" (reference ``slurm_train.sbatch:18-23``). TPU-first,
+the mesh IS the parallelism config: a 4-axis ``jax.sharding.Mesh`` over
+``('data', 'fsdp', 'tensor', 'context')``. Axes of size 1 cost nothing, so
+every workload uses the same mesh shape and the same PartitionSpecs — DP-only
+is just ``(n, 1, 1, 1)``.
+
+Axis layout order matters on hardware: ``jax.make_mesh`` assigns the
+fastest-varying (last) axes to the most tightly coupled devices, so we order
+axes (data, fsdp, tensor, context) → tensor/context land on intra-host ICI
+neighbours, data crosses DCN first — collectives ride ICI wherever possible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence, Tuple
+
+import jax
+from jax.sharding import Mesh
+
+from tpudist.config import ParallelConfig
+
+# canonical axis order, most-global first
+AXIS_NAMES: Tuple[str, ...] = ("data", "fsdp", "tensor", "context")
+
+
+@dataclass(frozen=True)
+class MeshAxes:
+    data: str = "data"
+    fsdp: str = "fsdp"
+    tensor: str = "tensor"
+    context: str = "context"
+
+
+def resolve_axis_sizes(cfg: ParallelConfig,
+                       n_devices: int) -> Tuple[int, int, int, int]:
+    """Resolve ``data=-1`` to "all remaining devices" and validate the
+    factorisation (the topology-probe analogue of the reference CI's
+    ``scontrol`` probe + sed patch, ci:115-119 — shapes are derived from the
+    live device count, never hard-coded)."""
+    fixed = cfg.fsdp * cfg.tensor * cfg.context
+    if fixed <= 0:
+        raise ValueError(f"axis sizes must be >=1, got {cfg}")
+    data = cfg.data
+    if data == -1:
+        if n_devices % fixed:
+            raise ValueError(
+                f"{n_devices} devices not divisible by fsdp*tensor*context="
+                f"{fixed}")
+        data = n_devices // fixed
+    if data * fixed != n_devices:
+        raise ValueError(
+            f"mesh {data}x{cfg.fsdp}x{cfg.tensor}x{cfg.context} != "
+            f"{n_devices} devices")
+    return (data, cfg.fsdp, cfg.tensor, cfg.context)
+
+
+def build_mesh(cfg: Optional[ParallelConfig] = None,
+               devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    cfg = cfg or ParallelConfig()
+    devices = list(devices) if devices is not None else jax.devices()
+    sizes = resolve_axis_sizes(cfg, len(devices))
+    if devices == jax.devices():
+        # jax.make_mesh knows the physical topology: fastest-varying axes
+        # land on ICI neighbours (a naive reshape of jax.devices() would
+        # give no such guarantee and could put tensor-parallel collectives
+        # on DCN). Axis types stay Auto: FSDP/TP rely on GSPMD propagation
+        # (make_mesh defaults to Explicit, which type-rejects those layouts).
+        auto = (jax.sharding.AxisType.Auto,) * len(AXIS_NAMES)
+        return jax.make_mesh(sizes, AXIS_NAMES, axis_types=auto)
+    import numpy as np
+    return Mesh(np.asarray(devices).reshape(sizes), AXIS_NAMES)

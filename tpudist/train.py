@@ -1,0 +1,175 @@
+"""The workload: synthetic-data distributed training (L1).
+
+Reference counterpart: the whole of ``train.py`` (reference
+``train.py:51-140``). Same observable contract:
+
+  * CLI flags ``--train-batch-size --epochs --lr --seed --save-dir`` with
+    unknown-flag tolerance (reference ``train.py:42-49``).
+  * stdout lines ``Epoch N finished. Avg loss: X`` and ``Training
+    completed.``, rank-0 only (reference ``train.py:121,128``).
+  * Exit code 0 on success; per-epoch checkpoints under ``--save-dir``.
+
+Beyond the reference: single-process mode works (fixes the set_epoch crash,
+SURVEY.md §3.2), resume from checkpoint, measured steps/sec/chip, a
+machine-readable verdict file, a transformer workload, and a documented
+fault-injection flag (``--fail-at``) instead of a commented-out exit(1).
+
+Run:  python -m tpudist.train --epochs 5 --train-batch-size 64
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+
+from tpudist import checkpoint as ckpt_lib
+from tpudist import data as data_lib
+from tpudist import engine as engine_lib
+from tpudist import verdict as verdict_lib
+from tpudist.config import TrainConfig, parse_args
+from tpudist.metrics import MetricsLogger, StepTimer, device_kind, log0
+from tpudist.parallel import build_mesh, distributed
+
+
+def run(cfg: TrainConfig) -> float:
+    """Train per config; returns the last epoch's average loss.
+
+    Raises on failure — ``main()`` turns exceptions into the fail verdict +
+    nonzero exit (the srun-equivalent signal chain).
+    """
+    ctx = distributed.initialize()
+    mesh = build_mesh(cfg.parallel)
+    log0(f"tpudist: {ctx.global_device_count} {device_kind()} device(s), "
+         f"{ctx.process_count} process(es), mesh "
+         f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
+
+    if mesh.shape["context"] > 1 and cfg.model.name != "transformer":
+        raise ValueError("--context > 1 (sequence parallelism) requires "
+                         "--model transformer")
+
+    batch_ways = mesh.shape["data"] * mesh.shape["fsdp"]
+    if cfg.batch_size % batch_ways:
+        raise ValueError(
+            f"--train-batch-size {cfg.batch_size} must be divisible by "
+            f"data*fsdp mesh size = {batch_ways}")
+    if cfg.batch_size % (batch_ways * cfg.grad_accum_steps):
+        raise ValueError(
+            f"--train-batch-size {cfg.batch_size} must be divisible by "
+            f"data*fsdp*grad_accum = {batch_ways * cfg.grad_accum_steps}")
+
+    # --- data (deterministic by seed; the convergence oracle) ---
+    if cfg.model.name == "mlp":
+        x, y = data_lib.make_synthetic_data(
+            cfg.data.n_samples, cfg.data.n_features, cfg.data.seed)
+
+        def epoch_batches(epoch):
+            return data_lib.shard_epoch(
+                x, y, batch_size=cfg.batch_size, seed=cfg.seed, epoch=epoch,
+                process_index=ctx.process_index,
+                process_count=ctx.process_count)
+    else:
+        toks = data_lib.make_synthetic_tokens(
+            cfg.data.n_samples, cfg.model.max_seq_len,
+            cfg.model.vocab_size, cfg.data.seed)
+        zeros = np.zeros((toks.shape[0],), np.float32)
+
+        def epoch_batches(epoch):
+            bx, _ = data_lib.shard_epoch(
+                toks, zeros, batch_size=cfg.batch_size, seed=cfg.seed,
+                epoch=epoch, process_index=ctx.process_index,
+                process_count=ctx.process_count)
+            return (bx,)
+
+    # --- model + engine (DeepSpeed-engine equivalent) ---
+    state = engine_lib.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    train_step = engine_lib.make_train_step(cfg, mesh)
+
+    start_epoch = 0
+    if cfg.resume:
+        restored = ckpt_lib.restore_latest(cfg.save_dir, state)
+        if restored is not None:
+            state, start_epoch = restored
+            log0(f"Resumed from epoch {start_epoch - 1} "
+                 f"(step {int(state.step)}).")
+
+    metrics = MetricsLogger(
+        path=os.path.join(cfg.save_dir, "metrics.jsonl")
+        if ctx.is_coordinator else None)
+    timer = StepTimer()
+    last_avg = float("nan")
+
+    for epoch in range(start_epoch, cfg.epochs):
+        batches = epoch_batches(epoch)
+        n_steps = jax.tree.leaves(batches)[0].shape[0]
+        total = 0.0
+        for i in range(n_steps):
+            batch = jax.tree.map(lambda a: a[i], batches)
+            timer.start()
+            state, loss = train_step(state, batch)
+            timer.stop(loss)
+            total += float(loss)
+            if cfg.log_every and (i + 1) % cfg.log_every == 0:
+                metrics.log(kind="step", epoch=epoch, step=int(state.step),
+                            loss=float(loss),
+                            steps_per_sec=timer.steps_per_sec())
+        last_avg = total / n_steps
+        # parity line, parsed by humans and tests alike (train.py:121)
+        log0(f"Epoch {epoch} finished. Avg loss: {last_avg:.4f}")
+        metrics.log(kind="epoch", epoch=epoch, avg_loss=last_avg,
+                    steps_per_sec=timer.steps_per_sec(),
+                    steps_per_sec_per_chip=timer.steps_per_sec_per_chip())
+        ckpt_lib.save(cfg.save_dir, state, epoch=epoch)
+
+        if cfg.fail_at is not None and epoch >= cfg.fail_at:
+            # Fault injection: prove the pipeline goes red (replaces the
+            # commented-out sys.exit(1) at reference train.py:129).
+            raise RuntimeError(
+                f"fault injection: --fail-at {cfg.fail_at} triggered")
+
+    log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
+         f"({timer.steps_per_sec_per_chip():.2f} steps/s/chip) on "
+         f"{jax.device_count()} chip(s)")
+    log0("Training completed.")  # parity banner (train.py:128)
+    metrics.close()
+    return last_avg
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    # Escape hatch for hosts whose site hooks pin a hardware platform at
+    # interpreter start (config-level override beats the env var there).
+    force = os.environ.get("TPUDIST_PLATFORM")
+    if force:
+        jax.config.update("jax_platforms", force)
+    cfg = parse_args(argv)
+    verdict_path = os.environ.get("TPUDIST_VERDICT_PATH")
+    ok = False
+    try:
+        run(cfg)
+        ok = True
+    except Exception as e:
+        print(f"tpudist: training failed: {e!r}", file=sys.stderr, flush=True)
+    finally:
+        # srun-equivalent signal chain: per-worker verdict → barrier →
+        # aggregated verdict file → exit code (slurm_train.sbatch:33-45).
+        try:
+            if verdict_path:
+                verdict_lib.write_worker_verdict(verdict_path, ok)
+            all_ok = verdict_lib.aggregate_ok(ok)
+            if verdict_path:
+                verdict_lib.write_final_verdict(verdict_path, all_ok)
+        except Exception as e:
+            print(f"tpudist: verdict plumbing failed: {e!r}",
+                  file=sys.stderr, flush=True)
+            all_ok = False
+        distributed.barrier("tpudist_end")
+        distributed.shutdown()
+    return 0 if ok and all_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

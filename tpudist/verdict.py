@@ -1,0 +1,65 @@
+"""Machine-readable job verdict — the acceptance-test signal.
+
+Reference counterpart: ``job_status.txt`` containing ``success``/``fail``
+written by the sbatch wrapper (reference ``slurm_train.sbatch:38,43``) and
+polled by CI (ci:152-181). SLURM gave job and CI a shared filesystem; TPU
+workers and CI share only GCS, so the verdict path may be a ``gs://`` URI —
+written via gsutil if available, else a local file (single-host / CI-local
+runs).
+
+Semantics preserved from srun: ANY worker failing fails the job. Every
+process writes a per-worker verdict; the coordinator aggregates after a
+barrier, so worker 3 crashing cannot yield a green verdict (SURVEY.md §7
+"hard parts": exit-code aggregation).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+
+import jax
+
+SUCCESS = "success"
+FAIL = "fail"
+
+
+def _write(path: str, content: str) -> None:
+    if path.startswith("gs://"):
+        # shell-free: path/content go as argv/stdin, immune to metacharacters
+        subprocess.run(["gsutil", "cp", "-", path], input=content.encode(),
+                       check=True, timeout=120)
+    else:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(content)
+        os.replace(tmp, path)
+
+
+def write_worker_verdict(path: str, ok: bool) -> None:
+    """Per-worker verdict: ``<path>.worker<i>`` (all ranks call this —
+    parity with every rank participating in the status protocol)."""
+    _write(f"{path}.worker{jax.process_index()}", SUCCESS if ok else FAIL)
+
+
+def write_final_verdict(path: str, ok: bool) -> None:
+    """Coordinator-only aggregate verdict at ``path`` itself. Call after
+    aggregate_ok() (or with a locally-known failure)."""
+    if jax.process_index() == 0:
+        _write(path, SUCCESS if ok else FAIL)
+
+
+def aggregate_ok(local_ok: bool) -> bool:
+    """AND-reduce success over all processes (srun semantics: one bad worker
+    fails the job). Uses a device all-reduce — if a worker died before this
+    point the collective itself fails, which is also a correct 'fail'."""
+    if jax.process_count() == 1:
+        return local_ok
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+    flag = multihost_utils.process_allgather(
+        jnp.asarray([1 if local_ok else 0], jnp.int32))
+    return bool(flag.min() == 1)
