@@ -1,0 +1,78 @@
+"""Headline benchmark: synthetic transformer training steps/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The reference publishes no numbers (BASELINE.md: "published: {}"), so
+``vs_baseline`` is reported as 1.0 by convention with the absolute value
+carrying the signal. The workload is BASELINE.json config #5 shaped to one
+chip: Llama-style block stack (4 layers, 2048 hidden, bf16) full train step
+(fwd+bwd+Adam) under jit, batch sized to keep the MXU busy.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+
+from tpudist import data, engine
+from tpudist.config import DataConfig, ModelConfig, ParallelConfig, TrainConfig
+
+
+def main() -> None:
+    from tpudist.utils import maybe_force_platform
+    maybe_force_platform()
+    n_dev = jax.device_count()
+    seq = 512
+    batch = 8 * n_dev
+    cfg = TrainConfig(
+        batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
+        data=DataConfig(n_samples=batch),
+        model=ModelConfig(name="transformer", vocab_size=32000, n_layers=4,
+                          d_model=2048, n_heads=16, n_kv_heads=16, d_ff=5504,
+                          max_seq_len=seq),
+        parallel=ParallelConfig(data=-1))
+
+    from tpudist.parallel import build_mesh
+    mesh = build_mesh(cfg.parallel)
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = engine.make_train_step(cfg, mesh)
+    toks = data.make_synthetic_tokens(batch, seq + 1, cfg.model.vocab_size,
+                                      seed=0)
+    batch_t = (toks,)
+
+    # warmup: trace + compile + first execution (fence via host transfer —
+    # on tunneled/remote PJRT backends block_until_ready can return before
+    # execution completes, inflating throughput ~100x)
+    for _ in range(2):
+        state, loss = step(state, batch_t)
+    float(loss)
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, batch_t)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    steps_per_sec_chip = iters / dt / n_dev
+    # model FLOPs (fwd+bwd ≈ 3×fwd) for context
+    toks_per_step = batch * seq
+    print(json.dumps({
+        "metric": "transformer_train_steps_per_sec_per_chip",
+        "value": round(steps_per_sec_chip, 4),
+        "unit": "steps/s/chip",
+        "vs_baseline": 1.0,
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": n_dev,
+            "global_batch": batch, "seq_len": seq,
+            "tokens_per_sec_per_chip": round(
+                toks_per_step * iters / dt / n_dev, 1),
+            "step_time_ms": round(1000 * dt / iters, 2),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

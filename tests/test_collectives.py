@@ -1,0 +1,77 @@
+"""Collective wrappers: correctness of results and of the bandwidth
+accounting (the measured fabric layer, SURVEY.md §5.8)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.config import ParallelConfig
+from tpudist.ops import collectives
+from tpudist.parallel import build_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh(devices8):
+    return build_mesh(ParallelConfig(), devices=devices8)
+
+
+def test_all_reduce_result(mesh):
+    op, x, nbytes = collectives.build_op("all_reduce", mesh, "data",
+                                         message_bytes=4096)
+    out = np.asarray(op(x))
+    # input was (8, E) with distinct rows; psum = column sum
+    expect = np.asarray(x).sum(axis=0)
+    np.testing.assert_allclose(out, expect, rtol=1e-6)
+    assert nbytes == out.size * 4
+
+
+def test_reduce_scatter_result(mesh):
+    op, x, _ = collectives.build_op("reduce_scatter", mesh, "data",
+                                    message_bytes=4096)
+    out = np.asarray(op(x))
+    np.testing.assert_allclose(out, np.asarray(x).sum(axis=0), rtol=1e-6)
+
+
+def test_all_gather_result(mesh):
+    op, x, _ = collectives.build_op("all_gather", mesh, "data",
+                                    message_bytes=4096)
+    np.testing.assert_array_equal(np.asarray(op(x)), np.asarray(x))
+
+
+def test_all_to_all_roundtrip(mesh):
+    op, x, _ = collectives.build_op("all_to_all", mesh, "data",
+                                    message_bytes=4096)
+    out = op(x)
+    # all_to_all is an involution for this tiled 1-D layout
+    out2 = np.asarray(op(out))
+    np.testing.assert_array_equal(out2, np.asarray(x))
+
+
+def test_ppermute_rotates(mesh):
+    op, x, _ = collectives.build_op("ppermute", mesh, "data",
+                                    message_bytes=1024)
+    out = np.asarray(op(x)).reshape(8, -1)
+    xs = np.asarray(x).reshape(8, -1)
+    np.testing.assert_array_equal(out, np.roll(xs, 1, axis=0))
+
+
+def test_bus_factor_math():
+    assert collectives.BUS_FACTOR["all_reduce"](8) == pytest.approx(1.75)
+    assert collectives.BUS_FACTOR["all_gather"](8) == pytest.approx(0.875)
+    assert collectives.BUS_FACTOR["ppermute"](8) == 1.0
+
+
+def test_time_collective_produces_sane_record(mesh):
+    t = collectives.time_collective("all_reduce", mesh, "data",
+                                    message_bytes=1 << 20, iters=3, warmup=1)
+    assert t.n_devices == 8
+    assert t.message_bytes == 1 << 20
+    assert t.min_s > 0 and t.mean_s >= t.min_s
+    assert t.bus_gbps == pytest.approx(t.algo_gbps * 1.75)
+
+
+def test_sweep_sizes():
+    from tpudist.bench import sweep_sizes
+    sizes = sweep_sizes(1, 1024)
+    assert sizes[0] == 1 << 20 and sizes[-1] == 1 << 30
+    assert all(b == a * 4 for a, b in zip(sizes, sizes[1:]))

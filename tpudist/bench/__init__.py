@@ -1,0 +1,3 @@
+from tpudist.bench.sweep import run_sweep, sweep_sizes
+
+__all__ = ["run_sweep", "sweep_sizes"]

@@ -1,0 +1,100 @@
+"""Collective bandwidth sweep (BASELINE.json config #4).
+
+The measured analogue of "did NCCL work" — the reference only ever observed
+its collectives as pass/fail through the training job; this sweeps message
+sizes 1MB→1GB per collective kind and reports bus bandwidth and % of the
+hardware's theoretical ring peak.
+
+Run:  python -m tpudist.bench.sweep [--kinds all_reduce,...] [--axis data]
+                                    [--min-mb 1] [--max-mb 1024]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import List, Optional
+
+import jax
+
+from tpudist.config import ParallelConfig
+from tpudist.metrics import device_kind, log0
+from tpudist.ops import collectives
+from tpudist.parallel import build_mesh
+
+# Approximate per-chip ICI ring peaks, GB/s of bus bandwidth along a 1-D
+# bidirectional ring (2 links active). Public figures: v4 ≈ 2×45, v5e ≈
+# 2×50, v5p ≈ 2×100 GB/s per link-direction. Used only to report % of
+# peak; absolute GB/s is always printed.
+RING_PEAK_GBPS = {
+    "TPU v4": 90.0,
+    "TPU v5 lite": 100.0,
+    "TPU v5e": 100.0,
+    "TPU v5": 200.0,
+    "TPU v5p": 200.0,
+    "TPU v6 lite": 180.0,
+}
+
+
+def ring_peak_gbps(kind_name: Optional[str] = None) -> Optional[float]:
+    name = kind_name or device_kind()
+    for k, v in sorted(RING_PEAK_GBPS.items(), key=lambda kv: -len(kv[0])):
+        if name.startswith(k):
+            return v
+    return None
+
+
+def sweep_sizes(min_mb: float = 1, max_mb: float = 1024) -> List[int]:
+    """1MB → 1GB in ×4 steps (7 buckets at defaults)."""
+    sizes, s = [], int(min_mb * 2**20)
+    top = int(max_mb * 2**20)
+    while s <= top:
+        sizes.append(s)
+        s *= 4
+    return sizes
+
+
+def run_sweep(kinds=("all_reduce",), axis: str = "data", *,
+              min_mb: float = 1, max_mb: float = 1024, iters: int = 10
+              ) -> List[dict]:
+    """Returns one record per (kind, size): message size, time, algo/bus
+    GB/s, % of ring peak (None off-TPU or unknown chip)."""
+    mesh = build_mesh(ParallelConfig())
+    n = mesh.shape[axis]
+    peak = ring_peak_gbps()
+    out = []
+    for kind in kinds:
+        for size in sweep_sizes(min_mb, max_mb):
+            t = collectives.time_collective(kind, mesh, axis,
+                                            message_bytes=size, iters=iters)
+            rec = {
+                "kind": kind, "n_devices": n,
+                "message_bytes": t.message_bytes,
+                "mean_s": t.mean_s, "min_s": t.min_s,
+                "algo_gbps": t.algo_gbps, "bus_gbps": t.bus_gbps,
+                "pct_of_ring_peak": (100 * t.bus_gbps / peak
+                                     if peak and n > 1 else None),
+            }
+            out.append(rec)
+            log0(json.dumps(rec))
+    return out
+
+
+def main(argv=None) -> int:
+    from tpudist.utils import maybe_force_platform
+    maybe_force_platform()
+    p = argparse.ArgumentParser()
+    p.add_argument("--kinds", type=str, default="all_reduce")
+    p.add_argument("--axis", type=str, default="data")
+    p.add_argument("--min-mb", type=float, default=1)
+    p.add_argument("--max-mb", type=float, default=1024)
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_known_args(argv)[0]
+    run_sweep(tuple(args.kinds.split(",")), args.axis,
+              min_mb=args.min_mb, max_mb=args.max_mb, iters=args.iters)
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -50,12 +50,24 @@ def _compute_dtype(cfg: TrainConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
-def make_loss_fn(cfg: TrainConfig) -> Callable:
-    """(params, batch) -> scalar loss, for the configured model."""
+def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None) -> Callable:
+    """(params, batch) -> scalar loss, for the configured model.
+
+    With a mesh whose ``context`` axis is >1, the transformer loss runs
+    context-parallel (sequence sharded, ring attention)."""
     model = get_model(cfg.model.name)
     dt = _compute_dtype(cfg)
     if cfg.model.name == "mlp":
         return functools.partial(model.loss_fn, dtype=dt)
+
+    cp = mesh is not None and mesh.shape.get("context", 1) > 1
+    if cp:
+        cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt)
+
+        def loss(params, batch):
+            tokens = batch[0] if isinstance(batch, tuple) else batch
+            return cp_loss(params, tokens)
+        return loss
 
     def loss(params, batch):
         tokens = batch[0] if isinstance(batch, tuple) else batch
@@ -149,7 +161,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     Chooses the explicit-psum shard_map path for pure-DP meshes, else the
     jit+shardings path. Loss returned is the global mean.
     """
-    loss_fn = make_loss_fn(cfg)
+    loss_fn = make_loss_fn(cfg, mesh)
     tx = make_optimizer(cfg)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     pure_dp = all(axis_sizes[a] == 1 for a in ("fsdp", "tensor", "context"))
@@ -205,7 +217,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
 
 def make_eval_fn(cfg: TrainConfig, mesh: Mesh) -> Callable:
     """(state, batch) -> global mean loss, no update."""
-    loss_fn = make_loss_fn(cfg)
+    loss_fn = make_loss_fn(cfg, mesh)
     jitted = jax.jit(lambda state, batch: loss_fn(state.params, batch))
 
     def ev(state, batch):

@@ -34,11 +34,15 @@ Params = Dict
 
 
 def precompute_rope(seq_len: int, head_dim: int, theta: float = 10000.0,
-                    offset: int = 0):
-    """RoPE cos/sin tables of shape (seq_len, head_dim//2), f32."""
+                    offset=0):
+    """RoPE cos/sin tables of shape (seq_len, head_dim//2), f32.
+
+    ``offset`` may be a traced scalar (context-parallel shards pass
+    ``axis_index * s_local`` for absolute positions), so it is added to a
+    static arange rather than baked into it."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                            dtype=jnp.float32) / head_dim))
-    t = jnp.arange(offset, offset + seq_len, dtype=jnp.float32)
+    t = jnp.arange(seq_len, dtype=jnp.float32) + offset
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -90,9 +94,14 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
 
 
 def _attention(q, k, v, *, causal: bool = True):
-    """Plain causal attention. q,k,v: (batch, seq, heads, head_dim).
+    """Plain causal attention. q: (batch, seq, heads, head_dim); k/v may
+    carry fewer (grouped-query) kv heads and are expanded here.
     Ring/context-parallel execution swaps this for
     tpudist.ops.ring_attention at the shard_map level."""
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
     hd = q.shape[-1]
     scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
         jnp.asarray(hd, q.dtype))
@@ -117,10 +126,8 @@ def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     v = (y @ lp["wv"].astype(dt)).reshape(b, s, kv, hd)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
-    if kv != h:  # grouped-query attention: repeat kv heads
-        rep = h // kv
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    # GQA: compact kv heads go to the attention impl as-is — ring attention
+    # must transfer the small blocks; expansion happens inside the kernel.
     o = attn_impl(q, k, v).reshape(b, s, h * hd)
     x = x + o @ lp["wo"].astype(dt)
 
@@ -181,11 +188,49 @@ def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
     }
 
 
+def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
             dtype=jnp.bfloat16) -> jax.Array:
     """Causal next-token cross-entropy over the synthetic token stream."""
     logits = apply(params, tokens[:, :-1], cfg, dtype=dtype)
-    targets = tokens[:, 1:]
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return _xent(logits, tokens[:, 1:])
+
+
+def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
+                    dtype=jnp.bfloat16):
+    """Context-parallel loss: sequence sharded over ``axis``, attention via
+    ring attention (tpudist.ops.ring_attention), RoPE offset per shard.
+
+    Only the ``axis`` mesh dimension is manualized (shard_map axis_names);
+    data/fsdp/tensor sharding of batch and params continues to flow through
+    the SPMD partitioner outside/inside the manual region. The token shift
+    happens BEFORE sharding so no halo exchange is needed; (seq_len) of the
+    shifted inputs must divide by the axis size.
+    """
+    from tpudist.ops.ring_attention import ring_attention_local
+
+    def loss(params: Params, tokens: jax.Array) -> jax.Array:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+
+        def body(params, inputs, targets):
+            s_local = inputs.shape[1]
+            off = lax.axis_index(axis) * s_local
+
+            def attn(q, k, v):
+                return ring_attention_local(q, k, v, axis, causal=True)
+
+            logits = apply(params, inputs, cfg, dtype=dtype,
+                           attn_impl=attn, rope_offset=off)
+            return lax.pmean(_xent(logits, targets), axis)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis)),
+            out_specs=P(), axis_names=frozenset({axis}),
+            check_vma=False)(params, inputs, targets)
+    return loss

@@ -1,0 +1,3 @@
+from tpudist.ops import collectives, ring_attention
+
+__all__ = ["collectives", "ring_attention"]

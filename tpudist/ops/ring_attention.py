@@ -1,0 +1,111 @@
+"""Ring attention — context/sequence parallelism over the ``context`` axis.
+
+Long-context extension (the reference has no sequence dimension at all,
+SURVEY.md §5.7; this is TPU-first design for scale): the sequence is sharded
+over the mesh's ``context`` axis; each device computes flash-style online
+softmax for its local query block while key/value blocks rotate around the
+ring via ``lax.ppermute`` — n_ctx hops overlap compute with neighbour ICI
+transfers, memory per device is O(S/n), and no device ever materialises the
+full S×S score matrix.
+
+Math: standard online-softmax accumulation (numerator, denominator, running
+max) in f32; a block fully masked by causality contributes exp(-1e30)=0
+rather than -inf arithmetic (NaN-safe).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+NEG = -1e30
+
+
+def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
+                         axis: str, *, causal: bool = True) -> jax.Array:
+    """Per-shard ring attention; call INSIDE shard_map.
+
+    q: local block ``(batch, s_local, heads, head_dim)``; k, v may have
+    fewer (grouped-query) kv heads — GQA expansion happens inside the block
+    compute, so only the COMPACT kv blocks travel the ring. The sequence
+    dim is sharded over ``axis``. n-1 hops total: the local block is
+    consumed before the first rotation and the last block is not forwarded.
+    Returns the local output block ``(batch, s_local, heads, head_dim)``.
+    """
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, s, h, d = q.shape
+    kv = k.shape[2]
+    rep = h // kv
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qf = q.astype(jnp.float32)
+
+    q_pos = me * s + jnp.arange(s)  # absolute positions of local queries
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def consume(k_cur, v_cur, src, num, den, mx):
+        """Online-softmax update with the block whose global index is src."""
+        kf = k_cur.astype(jnp.float32)
+        vf = v_cur.astype(jnp.float32)
+        if rep != 1:
+            kf = jnp.repeat(kf, rep, axis=2)
+            vf = jnp.repeat(vf, rep, axis=2)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+        if causal:
+            k_pos = src * s + jnp.arange(s)
+            mask = q_pos[:, None] >= k_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG)
+        blk_max = jnp.max(scores, axis=-1)                    # (b,h,q)
+        new_mx = jnp.maximum(mx, blk_max)
+        corr = jnp.exp(mx - new_mx)
+        p = jnp.exp(scores - new_mx[..., None])               # (b,h,q,k)
+        num = num * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
+        den = den * corr + jnp.sum(p, axis=-1)
+        return num, den, new_mx
+
+    num0 = jnp.zeros((b, h, s, d), jnp.float32)
+    den0 = jnp.zeros((b, h, s), jnp.float32)
+    mx0 = jnp.full((b, h, s), NEG, jnp.float32)
+    # hop 0: the local block, no transfer
+    num, den, mx = consume(k, v, me, num0, den0, mx0)
+
+    def step(i, carry):
+        k_cur, v_cur, num, den, mx = carry
+        # rotate FIRST (ICI neighbour transfer of compact kv), then consume
+        k_cur = lax.ppermute(k_cur, axis, perm=perm)
+        v_cur = lax.ppermute(v_cur, axis, perm=perm)
+        num, den, mx = consume(k_cur, v_cur, (me - i) % n, num, den, mx)
+        return k_cur, v_cur, num, den, mx
+
+    _, _, num, den, _ = lax.fori_loop(1, n, step, (k, v, num, den, mx))
+
+    out = num / jnp.maximum(den, 1e-30)[..., None]            # (b,h,q,d)
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)          # (b,q,h,d)
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "context", *,
+                        causal: bool = True):
+    """Standalone jitted ring attention on globally (seq-)sharded arrays.
+
+    q, k, v: ``(batch, seq, heads, head_dim)`` with seq sharded over
+    ``axis``. Used directly by tests and by context-parallel model code.
+    """
+    spec = P(None, axis, None, None)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    def f(q, k, v):
+        return ring_attention_local(q, k, v, axis, causal=causal)
+
+    jf = jax.jit(f)
+
+    def apply(q, k, v):
+        sh = NamedSharding(mesh, spec)
+        return jf(jax.device_put(q, sh), jax.device_put(k, sh),
+                  jax.device_put(v, sh))
+    return apply

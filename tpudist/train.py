@@ -48,9 +48,14 @@ def run(cfg: TrainConfig) -> float:
          f"{ctx.process_count} process(es), mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
-    if mesh.shape["context"] > 1 and cfg.model.name != "transformer":
-        raise ValueError("--context > 1 (sequence parallelism) requires "
-                         "--model transformer")
+    if mesh.shape["context"] > 1:
+        if cfg.model.name != "transformer":
+            raise ValueError("--context > 1 (sequence parallelism) requires "
+                             "--model transformer")
+        if cfg.model.max_seq_len % mesh.shape["context"]:
+            raise ValueError(
+                f"--seq-len {cfg.model.max_seq_len} must be divisible by "
+                f"--context {mesh.shape['context']}")
 
     batch_ways = mesh.shape["data"] * mesh.shape["fsdp"]
     if cfg.batch_size % batch_ways:
@@ -73,8 +78,10 @@ def run(cfg: TrainConfig) -> float:
                 process_index=ctx.process_index,
                 process_count=ctx.process_count)
     else:
+        # seq_len+1 tokens: the causal shift consumes one, so the model
+        # sees exactly max_seq_len positions (divisible by the context axis)
         toks = data_lib.make_synthetic_tokens(
-            cfg.data.n_samples, cfg.model.max_seq_len,
+            cfg.data.n_samples, cfg.model.max_seq_len + 1,
             cfg.model.vocab_size, cfg.data.seed)
         zeros = np.zeros((toks.shape[0],), np.float32)
 
@@ -111,8 +118,11 @@ def run(cfg: TrainConfig) -> float:
             batch = jax.tree.map(lambda a: a[i], batches)
             timer.start()
             state, loss = train_step(state, batch)
-            timer.stop(loss)
-            total += float(loss)
+            # fence via host transfer: on tunneled PJRT backends
+            # block_until_ready can return before execution completes
+            loss_val = float(loss)
+            timer.stop()
+            total += loss_val
             if cfg.log_every and (i + 1) % cfg.log_every == 0:
                 metrics.log(kind="step", epoch=epoch, step=int(state.step),
                             loss=float(loss),
@@ -140,11 +150,8 @@ def run(cfg: TrainConfig) -> float:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    # Escape hatch for hosts whose site hooks pin a hardware platform at
-    # interpreter start (config-level override beats the env var there).
-    force = os.environ.get("TPUDIST_PLATFORM")
-    if force:
-        jax.config.update("jax_platforms", force)
+    from tpudist.utils import maybe_force_platform
+    maybe_force_platform()
     cfg = parse_args(argv)
     verdict_path = os.environ.get("TPUDIST_VERDICT_PATH")
     ok = False

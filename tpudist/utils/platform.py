@@ -1,0 +1,18 @@
+"""Platform override honored by every CLI entry point.
+
+Some hosts pin JAX to a hardware backend from a site hook at interpreter
+start, which silently defeats the ``JAX_PLATFORMS`` env var (the config was
+already updated by the hook). ``TPUDIST_PLATFORM=cpu`` re-overrides at the
+config level; it must run before any backend is initialized.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def maybe_force_platform() -> None:
+    force = os.environ.get("TPUDIST_PLATFORM")
+    if force:
+        import jax
+        jax.config.update("jax_platforms", force)
