@@ -16,7 +16,8 @@ import time
 import jax
 
 from tpudist import data, engine
-from tpudist.config import DataConfig, ModelConfig, ParallelConfig, TrainConfig
+from tpudist.config import (DataConfig, ParallelConfig, TrainConfig,
+                            flagship_model_config)
 
 
 def main() -> None:
@@ -28,9 +29,7 @@ def main() -> None:
     cfg = TrainConfig(
         batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
         data=DataConfig(n_samples=batch),
-        model=ModelConfig(name="transformer", vocab_size=32000, n_layers=4,
-                          d_model=2048, n_heads=16, n_kv_heads=16, d_ff=5504,
-                          max_seq_len=seq),
+        model=flagship_model_config(max_seq_len=seq),
         parallel=ParallelConfig(data=-1))
 
     from tpudist.parallel import build_mesh

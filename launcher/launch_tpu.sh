@@ -1,0 +1,93 @@
+#!/usr/bin/env bash
+# Multi-host TPU launcher (L3) — replaces slurm_train.sbatch.
+#
+# Reference mechanism (slurm_train.sbatch:11-45): derive MASTER_ADDR from the
+# SLURM nodelist, srun one launcher per node inside the container, write
+# job_status.txt. TPU-native mechanism: create a queued-resources TPU slice,
+# run the workload on every worker with --worker=all (jax.distributed
+# auto-discovers the coordinator from TPU metadata — no MASTER_ADDR dance),
+# aggregate per-worker verdicts into a GCS object the CI poller reads.
+#
+# Usage:
+#   ACCELERATOR_TYPE=v5p-16 RUNTIME_VERSION=v2-alpha-tpuv5 \
+#   GCS_VERDICT=gs://bucket/runs/$RUN_ID/job_status.txt \
+#   ./launcher/launch_tpu.sh [extra tpudist.train flags...]
+#
+# Required env:
+#   TPU_NAME            name for the queued resource / TPU VM
+#   ZONE, PROJECT       GCP placement
+#   ACCELERATOR_TYPE    e.g. v5p-16 (topology is probed from this — the
+#                       analogue of the reference CI's scontrol probe)
+#   GCS_VERDICT         gs:// URI for the machine-readable verdict
+# Optional:
+#   RUNTIME_VERSION     TPU software version (default v2-alpha-tpuv5)
+#   IMAGE               docker image to run (default: bare python on TPU-VM)
+#   TIMEOUT_S           provisioning+run timeout (default 1800)
+
+set -euo pipefail
+
+: "${TPU_NAME:?set TPU_NAME}"
+: "${ZONE:?set ZONE}"
+: "${PROJECT:?set PROJECT}"
+: "${ACCELERATOR_TYPE:?set ACCELERATOR_TYPE}"
+: "${GCS_VERDICT:?set GCS_VERDICT}"
+RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5}"
+TIMEOUT_S="${TIMEOUT_S:-1800}"
+EXTRA_FLAGS=("$@")
+
+cleanup() {
+  # idempotent teardown — a red run must not leak a reserved slice
+  # (the scancel-equivalent; SURVEY.md §7 "hard parts")
+  gcloud compute tpus queued-resources delete "$TPU_NAME" \
+    --zone "$ZONE" --project "$PROJECT" --quiet --force 2>/dev/null || true
+}
+trap cleanup EXIT
+
+echo "creating queued resource $TPU_NAME ($ACCELERATOR_TYPE) ..."
+gcloud compute tpus queued-resources create "$TPU_NAME" \
+  --node-id "$TPU_NAME" \
+  --zone "$ZONE" --project "$PROJECT" \
+  --accelerator-type "$ACCELERATOR_TYPE" \
+  --runtime-version "$RUNTIME_VERSION"
+
+# poll until ACTIVE — provisioning is async and can WAIT indefinitely;
+# same timeout discipline as the reference CI's squeue loop (ci:130-150)
+deadline=$((SECONDS + TIMEOUT_S))
+while :; do
+  state=$(gcloud compute tpus queued-resources describe "$TPU_NAME" \
+            --zone "$ZONE" --project "$PROJECT" \
+            --format='value(state.state)' 2>/dev/null || echo UNKNOWN)
+  echo "queued-resource state: $state"
+  case "$state" in
+    ACTIVE) break ;;
+    FAILED|SUSPENDED) echo "provisioning failed: $state"; exit 1 ;;
+  esac
+  if (( SECONDS > deadline )); then
+    echo "timeout waiting for TPU slice"; exit 124
+  fi
+  sleep 10
+done
+
+# run the workload on EVERY worker; jax.distributed.initialize() discovers
+# coordinator + process count from TPU metadata. Any worker's nonzero exit
+# fails the ssh command (srun semantics, slurm_train.sbatch:34-44).
+set +e
+gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+  --zone "$ZONE" --project "$PROJECT" --worker=all \
+  --command "\
+    sudo docker run --rm --privileged --network host \
+      -e TPUDIST_VERDICT_PATH='$GCS_VERDICT' \
+      ${IMAGE:+$IMAGE} \
+      ${IMAGE:-python3 -m tpudist.train} ${EXTRA_FLAGS[*]:-}"
+RC=$?
+set -e
+
+if [ $RC -eq 0 ]; then
+  echo "✅ distributed TPU job succeeded"
+else
+  echo "❌ distributed TPU job failed (rc=$RC)"
+  # the workload's coordinator normally writes the verdict itself; cover
+  # the crashed-before-verdict case so CI never hangs on a missing object
+  echo -n fail | gsutil cp - "$GCS_VERDICT" || true
+fi
+exit $RC

@@ -45,7 +45,7 @@ def ring_peak_gbps(kind_name: Optional[str] = None) -> Optional[float]:
 
 
 def sweep_sizes(min_mb: float = 1, max_mb: float = 1024) -> List[int]:
-    """1MB → 1GB in ×4 steps (7 buckets at defaults)."""
+    """1MB → 1GB in ×4 steps (6 buckets at defaults)."""
     sizes, s = [], int(min_mb * 2**20)
     top = int(max_mb * 2**20)
     while s <= top:
@@ -89,7 +89,8 @@ def main(argv=None) -> int:
     p.add_argument("--min-mb", type=float, default=1)
     p.add_argument("--max-mb", type=float, default=1024)
     p.add_argument("--iters", type=int, default=10)
-    args = p.parse_known_args(argv)[0]
+    # strict: a mistyped flag must error, not silently run a full 1GB sweep
+    args = p.parse_args(argv)
     run_sweep(tuple(args.kinds.split(",")), args.axis,
               min_mb=args.min_mb, max_mb=args.max_mb, iters=args.iters)
     return 0

@@ -77,6 +77,15 @@ class TrainConfig:
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
 
 
+def flagship_model_config(max_seq_len: int = 512) -> ModelConfig:
+    """BASELINE.json config #5: the synthetic Llama-block transformer
+    (4 layers, 2048 hidden, 16 heads, SwiGLU 5504). Single source of truth
+    for the headline benchmark and the driver compile-check entry."""
+    return ModelConfig(name="transformer", vocab_size=32000, n_layers=4,
+                       d_model=2048, n_heads=16, n_kv_heads=16, d_ff=5504,
+                       max_seq_len=max_seq_len)
+
+
 def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     """CLI → TrainConfig. Unknown flags are tolerated (parity with the
     reference's ``parse_known_args()[0]``), so launchers may pass extra

@@ -65,11 +65,18 @@ def build_op(kind: str, mesh: Mesh, axis: str, *, message_bytes: int,
     elems = max(message_bytes // item, n)
     elems = (elems // n) * n
 
+    def _sharded_iota(total, spec, shape=None):
+        """Generate the input directly in its sharded layout — each device
+        materialises only its own shard (a host-side arange would land on
+        one device first and OOM at GB sizes × slice width)."""
+        def gen():
+            v = jnp.arange(total, dtype=dtype)
+            return v.reshape(shape) if shape else v
+        return jax.jit(gen, out_shardings=NamedSharding(mesh, spec))()
+
     if kind in ("all_reduce", "reduce_scatter"):
         # each device holds a DISTINCT full buffer: global (n, E), P(axis)
-        x = jax.device_put(
-            jnp.arange(n * elems, dtype=dtype).reshape(n, elems),
-            NamedSharding(mesh, P(axis, None)))
+        x = _sharded_iota(n * elems, P(axis, None), shape=(n, elems))
 
         if kind == "all_reduce":
             def body(v):
@@ -83,8 +90,7 @@ def build_op(kind: str, mesh: Mesh, axis: str, *, message_bytes: int,
                            out_specs=out_spec, check_vma=False)
     elif kind == "all_gather":
         # shards of E/n gather into the full E buffer on every device
-        x = jax.device_put(jnp.arange(elems, dtype=dtype),
-                           NamedSharding(mesh, P(axis)))
+        x = _sharded_iota(elems, P(axis))
 
         def body(v):
             return lax.all_gather(v, axis, tiled=True)
@@ -92,8 +98,7 @@ def build_op(kind: str, mesh: Mesh, axis: str, *, message_bytes: int,
                            out_specs=P(None), check_vma=False)
     elif kind == "all_to_all":
         # each device's send buffer is E (global n·E), exchanged n-ways
-        x = jax.device_put(jnp.arange(n * elems, dtype=dtype),
-                           NamedSharding(mesh, P(axis)))
+        x = _sharded_iota(n * elems, P(axis))
 
         def body(v):
             return lax.all_to_all(v, axis, split_axis=0, concat_axis=0,
@@ -101,8 +106,7 @@ def build_op(kind: str, mesh: Mesh, axis: str, *, message_bytes: int,
         fn = jax.shard_map(body, mesh=mesh, in_specs=P(axis),
                            out_specs=P(axis), check_vma=False)
     else:  # ppermute: each device passes its E-buffer one hop around the ring
-        x = jax.device_put(jnp.arange(n * elems, dtype=dtype),
-                           NamedSharding(mesh, P(axis)))
+        x = _sharded_iota(n * elems, P(axis))
 
         def body(v):
             return lax.ppermute(v, axis, perm=_ring_perm(n))

@@ -125,7 +125,7 @@ def run(cfg: TrainConfig) -> float:
             total += loss_val
             if cfg.log_every and (i + 1) % cfg.log_every == 0:
                 metrics.log(kind="step", epoch=epoch, step=int(state.step),
-                            loss=float(loss),
+                            loss=loss_val,
                             steps_per_sec=timer.steps_per_sec())
         last_avg = total / n_steps
         # parity line, parsed by humans and tests alike (train.py:121)
