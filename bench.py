@@ -25,7 +25,9 @@ def main() -> None:
     maybe_force_platform()
     n_dev = jax.device_count()
     seq = 512
-    batch = 8 * n_dev
+    # 24/chip: measured sweet spot on v5e (69k tok/s/chip; 16→65k, 28→67k,
+    # 30+ degrades under memory pressure)
+    batch = 24 * n_dev
     cfg = TrainConfig(
         batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
         data=DataConfig(n_samples=batch),
@@ -54,20 +56,18 @@ def main() -> None:
     float(loss)
     dt = time.perf_counter() - t0
 
-    steps_per_sec_chip = iters / dt / n_dev
-    # model FLOPs (fwd+bwd ≈ 3×fwd) for context
     toks_per_step = batch * seq
+    tok_s_chip = toks_per_step * iters / dt / n_dev
     print(json.dumps({
-        "metric": "transformer_train_steps_per_sec_per_chip",
-        "value": round(steps_per_sec_chip, 4),
-        "unit": "steps/s/chip",
+        "metric": "transformer_train_tokens_per_sec_per_chip",
+        "value": round(tok_s_chip, 1),
+        "unit": "tokens/s/chip",
         "vs_baseline": 1.0,
         "detail": {
             "device": jax.devices()[0].device_kind,
             "n_devices": n_dev,
             "global_batch": batch, "seq_len": seq,
-            "tokens_per_sec_per_chip": round(
-                toks_per_step * iters / dt / n_dev, 1),
+            "steps_per_sec_per_chip": round(iters / dt / n_dev, 4),
             "step_time_ms": round(1000 * dt / iters, 2),
         },
     }))

@@ -68,26 +68,43 @@ while :; do
   sleep 10
 done
 
-# run the workload on EVERY worker; jax.distributed.initialize() discovers
+# Run the workload on EVERY worker; jax.distributed.initialize() discovers
 # coordinator + process count from TPU metadata. Any worker's nonzero exit
 # fails the ssh command (srun semantics, slurm_train.sbatch:34-44).
+#
+# With IMAGE set, the containerized workload runs; otherwise the bare
+# TPU-VM python runs the pip-installed package. The container does NOT get
+# a gs:// verdict path — the image has no gsutil, and the verdict is this
+# wrapper's job anyway (same division of labor as the reference: the sbatch
+# wrapper writes job_status.txt from the workload's exit code,
+# slurm_train.sbatch:33-45).
+if [ -n "${IMAGE:-}" ]; then
+  REMOTE_CMD="sudo docker pull $IMAGE && \
+    sudo docker run --rm --privileged --network host $IMAGE \
+      python3 -m tpudist.train ${EXTRA_FLAGS[*]:-}"
+else
+  REMOTE_CMD="python3 -m tpudist.train ${EXTRA_FLAGS[*]:-}"
+fi
+
 set +e
 gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
   --zone "$ZONE" --project "$PROJECT" --worker=all \
-  --command "\
-    sudo docker run --rm --privileged --network host \
-      -e TPUDIST_VERDICT_PATH='$GCS_VERDICT' \
-      ${IMAGE:+$IMAGE} \
-      ${IMAGE:-python3 -m tpudist.train} ${EXTRA_FLAGS[*]:-}"
+  --command "$REMOTE_CMD"
 RC=$?
 set -e
 
 if [ $RC -eq 0 ]; then
   echo "✅ distributed TPU job succeeded"
+  if [ "${RUN_SWEEP:-0}" = "1" ]; then
+    # measure while the slice is still alive (teardown runs on EXIT)
+    gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+      --zone "$ZONE" --project "$PROJECT" --worker=0 \
+      --command "python3 -m tpudist.bench.sweep --kinds all_reduce" \
+      | tee sweep.jsonl || true
+  fi
+  echo -n success | gsutil cp - "$GCS_VERDICT"
 else
   echo "❌ distributed TPU job failed (rc=$RC)"
-  # the workload's coordinator normally writes the verdict itself; cover
-  # the crashed-before-verdict case so CI never hangs on a missing object
   echo -n fail | gsutil cp - "$GCS_VERDICT" || true
 fi
 exit $RC

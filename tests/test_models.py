@@ -75,6 +75,28 @@ def test_transformer_rope_offset_matches_full_sequence():
                                rtol=1e-6)
 
 
+def test_remat_and_chunked_xent_match_plain():
+    """jax.checkpoint layers and the streamed LM-head loss are pure memory
+    optimisations — loss must be identical to the plain path."""
+    from tpudist import data
+    toks = data.make_synthetic_tokens(4, 17, 97, seed=0)
+    p = transformer.init(jax.random.PRNGKey(0), TINY_TF)
+    base = transformer.loss_fn(p, toks, TINY_TF, dtype=jnp.float32)
+    remat = transformer.loss_fn(p, toks, TINY_TF, dtype=jnp.float32,
+                                remat=True)
+    chunked = transformer.loss_fn(p, toks, TINY_TF, dtype=jnp.float32,
+                                  xent_chunks=4)
+    np.testing.assert_allclose(float(remat), float(base), rtol=1e-6)
+    np.testing.assert_allclose(float(chunked), float(base), rtol=1e-5)
+    # gradients too (checkpoint/scan change the backward schedule)
+    g_base = jax.grad(lambda q: transformer.loss_fn(
+        q, toks, TINY_TF, dtype=jnp.float32))(p)
+    g_ch = jax.grad(lambda q: transformer.loss_fn(
+        q, toks, TINY_TF, dtype=jnp.float32, remat=True, xent_chunks=4))(p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), g_base, g_ch)
+
+
 def test_transformer_loss_decreases_under_adam():
     import optax
     from tpudist import data

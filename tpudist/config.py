@@ -70,8 +70,11 @@ class TrainConfig:
     resume: bool = False
     grad_accum_steps: int = 1
     dtype: str = "float32"        # compute dtype: float32 | bfloat16
+    remat: bool = False           # checkpoint transformer layers
+    xent_chunks: int = 0          # stream LM head+loss over N seq chunks
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
+    profile_dir: Optional[str] = None  # write jax.profiler traces here
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -104,6 +107,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--grad-accum-steps", type=int, default=1)
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialise transformer layers in backward")
+    p.add_argument("--xent-chunks", type=int, default=0,
+                   help="stream the LM head + cross-entropy over N sequence "
+                        "chunks instead of materialising full logits")
     p.add_argument("--n-samples", type=int, default=2000)
     p.add_argument("--n-features", type=int, default=20)
     # transformer shape (defaults = BASELINE.json config #5: 4 layers, 2k hidden)
@@ -121,6 +129,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="fault injection: fail after this epoch (replaces the "
                         "reference's commented-out sys.exit(1), train.py:129)")
     p.add_argument("--log-every", type=int, default=100)
+    p.add_argument("--profile-dir", type=str, default=None,
+                   help="write jax.profiler traces (tensorboard format) "
+                        "here; the reference had no profiling at all "
+                        "(SURVEY.md §5.1)")
     args = p.parse_known_args(argv)[0]
 
     return TrainConfig(
@@ -132,8 +144,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         resume=args.resume,
         grad_accum_steps=args.grad_accum_steps,
         dtype=args.dtype,
+        remat=args.remat,
+        xent_chunks=args.xent_chunks,
         fail_at=args.fail_at,
         log_every=args.log_every,
+        profile_dir=args.profile_dir,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

@@ -62,7 +62,9 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None) -> Callable:
 
     cp = mesh is not None and mesh.shape.get("context", 1) > 1
     if cp:
-        cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt)
+        cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt,
+                                        remat=cfg.remat,
+                                        xent_chunks=cfg.xent_chunks)
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch
@@ -71,7 +73,8 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None) -> Callable:
 
     def loss(params, batch):
         tokens = batch[0] if isinstance(batch, tuple) else batch
-        return model.loss_fn(params, tokens, cfg.model, dtype=dt)
+        return model.loss_fn(params, tokens, cfg.model, dtype=dt,
+                             remat=cfg.remat, xent_chunks=cfg.xent_chunks)
     return loss
 
 
@@ -96,16 +99,17 @@ def state_shardings(cfg: TrainConfig, mesh: Mesh) -> TrainState:
     params' layout (ZeRO-style: optimizer state lives where the shard
     lives); scalar leaves are replicated."""
     model = get_model(cfg.model.name)
-    pspecs = model.param_specs(cfg.model)
+    params_shape = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), cfg.model))
+    # drop axes that don't divide a dim (vocab 97 over fsdp=2 → replicated)
+    pspecs = shd.sanitize_specs(params_shape, model.param_specs(cfg.model),
+                                mesh)
     psh = shd.named(mesh, pspecs)
     # optax adam state is a tuple of states where mu/nu are params-shaped
     # pytrees; those subtrees get the params' layout (ZeRO-style: optimizer
     # state lives with the shard), everything else is replicated.
     params_struct = jax.tree.structure(psh)
     tx = make_optimizer(cfg)
-    params_shape = jax.eval_shape(
-        lambda: get_model(cfg.model.name).init(
-            jax.random.PRNGKey(0), cfg.model))
     opt_shape = jax.eval_shape(tx.init, params_shape)
     # Walk the opt-state shape; replace params-shaped subtrees with psh.
     opt_sh = _match_subtrees(opt_shape, params_struct, psh, mesh)

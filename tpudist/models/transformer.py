@@ -138,14 +138,13 @@ def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     return x
 
 
-def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
-          dtype=jnp.bfloat16, attn_impl=_attention,
-          rope_offset: int = 0) -> jax.Array:
-    """Forward: tokens (batch, seq) int32 -> logits (batch, seq, vocab) f32.
-
-    ``attn_impl`` lets context-parallel callers substitute ring attention;
-    ``rope_offset`` gives each context shard its absolute positions.
-    """
+def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+                  dtype=jnp.bfloat16, attn_impl=_attention,
+                  rope_offset=0, remat: bool = False) -> jax.Array:
+    """Backbone forward: tokens (batch, seq) -> final-norm hidden states
+    (batch, seq, d_model) in ``dtype``. ``remat`` checkpoints each layer
+    (recompute activations in backward — HBM for FLOPs, the standard TPU
+    trade when memory, not compute, limits batch size)."""
     s = tokens.shape[1]
     hd = cfg.d_model // cfg.n_heads
     cos, sin = precompute_rope(s, hd, cfg.rope_theta, offset=rope_offset)
@@ -154,8 +153,22 @@ def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
     def body(x, lp):
         return _layer(x, lp, cfg, cos, sin, attn_impl), None
 
+    if remat:
+        body = jax.checkpoint(body)
     x, _ = lax.scan(body, x, params["layers"])
-    x = rmsnorm(x, params["final_norm"])
+    return rmsnorm(x, params["final_norm"])
+
+
+def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+          dtype=jnp.bfloat16, attn_impl=_attention,
+          rope_offset=0, remat: bool = False) -> jax.Array:
+    """Forward: tokens (batch, seq) int32 -> logits (batch, seq, vocab) f32.
+
+    ``attn_impl`` lets context-parallel callers substitute ring attention;
+    ``rope_offset`` gives each context shard its absolute positions.
+    """
+    x = hidden_states(params, tokens, cfg, dtype=dtype, attn_impl=attn_impl,
+                      rope_offset=rope_offset, remat=remat)
     # tied output head
     return (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
 
@@ -194,15 +207,54 @@ def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
     return jnp.mean(logz - gold)
 
 
+def _chunked_head_xent(embed: jax.Array, h: jax.Array, targets: jax.Array,
+                       n_chunks: int) -> jax.Array:
+    """Tied-head projection + cross-entropy, chunked over the sequence and
+    checkpointed: the (batch, seq, vocab) f32 logits tensor — the single
+    biggest buffer in the train step (0.5GB at batch 8/seq 512/vocab 32k) —
+    is never materialised whole; backward recomputes each chunk's logits.
+    """
+    b, s, d = h.shape
+    hc = h.reshape(b, n_chunks, s // n_chunks, d).transpose(1, 0, 2, 3)
+    tc = targets.reshape(b, n_chunks, s // n_chunks).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def chunk_loss(hx, tx):
+        logits = (hx @ embed.T).astype(jnp.float32)
+        return _xent(logits, tx)
+
+    def body(acc, ht):
+        return acc + chunk_loss(*ht), None
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32), (hc, tc))
+    return total / n_chunks
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
-            dtype=jnp.bfloat16) -> jax.Array:
-    """Causal next-token cross-entropy over the synthetic token stream."""
-    logits = apply(params, tokens[:, :-1], cfg, dtype=dtype)
-    return _xent(logits, tokens[:, 1:])
+            dtype=jnp.bfloat16, remat: bool = False,
+            xent_chunks: int = 0) -> jax.Array:
+    """Causal next-token cross-entropy over the synthetic token stream.
+
+    ``xent_chunks`` > 0 streams the LM head + loss over that many sequence
+    chunks (memory-bound win at large batch×seq×vocab); 0 keeps the simple
+    whole-logits path."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if xent_chunks:
+        if targets.shape[1] % xent_chunks:
+            # erroring beats silently materialising the full logits tensor
+            # the flag was passed to avoid
+            raise ValueError(
+                f"sequence length {targets.shape[1]} not divisible by "
+                f"xent_chunks={xent_chunks}")
+        h = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
+        return _chunked_head_xent(params["embed"].astype(dtype), h, targets,
+                                  xent_chunks)
+    logits = apply(params, inputs, cfg, dtype=dtype, remat=remat)
+    return _xent(logits, targets)
 
 
 def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
-                    dtype=jnp.bfloat16):
+                    dtype=jnp.bfloat16, remat: bool = False,
+                    xent_chunks: int = 0):
     """Context-parallel loss: sequence sharded over ``axis``, attention via
     ring attention (tpudist.ops.ring_attention), RoPE offset per shard.
 
@@ -224,9 +276,21 @@ def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
             def attn(q, k, v):
                 return ring_attention_local(q, k, v, axis, causal=True)
 
-            logits = apply(params, inputs, cfg, dtype=dtype,
-                           attn_impl=attn, rope_offset=off)
-            return lax.pmean(_xent(logits, targets), axis)
+            if xent_chunks:
+                if s_local % xent_chunks:
+                    raise ValueError(
+                        f"local sequence {s_local} not divisible by "
+                        f"xent_chunks={xent_chunks}")
+                h = hidden_states(params, inputs, cfg, dtype=dtype,
+                                  attn_impl=attn, rope_offset=off,
+                                  remat=remat)
+                local = _chunked_head_xent(params["embed"].astype(dtype), h,
+                                           targets, xent_chunks)
+            else:
+                logits = apply(params, inputs, cfg, dtype=dtype,
+                               attn_impl=attn, rope_offset=off, remat=remat)
+                local = _xent(logits, targets)
+            return lax.pmean(local, axis)
 
         return jax.shard_map(
             body, mesh=mesh,

@@ -14,6 +14,27 @@ from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
 
+def sanitize_specs(shape_tree, spec_tree, mesh: Mesh):
+    """Drop sharding axes that don't divide the corresponding dim evenly
+    (e.g. a vocab of 97 over fsdp=2): those dims fall back to replicated,
+    which is always legal. Keeps model PartitionSpecs mesh-agnostic."""
+    def fix(shape, spec):
+        dims = list(spec) + [None] * (len(shape.shape) - len(spec))
+        out = []
+        for size, axes in zip(shape.shape, dims):
+            if axes is None:
+                out.append(None)
+                continue
+            axes_t = axes if isinstance(axes, tuple) else (axes,)
+            ways = 1
+            for a in axes_t:
+                ways *= mesh.shape[a]
+            out.append(axes if size % ways == 0 else None)
+        return P(*out)
+    return jax.tree.map(fix, shape_tree, spec_tree,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
 def named(mesh: Mesh, spec_tree):
     """Map a pytree of PartitionSpecs to NamedShardings on ``mesh``."""
     return jax.tree.map(

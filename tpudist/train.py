@@ -110,6 +110,25 @@ def run(cfg: TrainConfig) -> float:
     timer = StepTimer()
     last_avg = float("nan")
 
+    import contextlib
+    profile_cm = (jax.profiler.trace(cfg.profile_dir)
+                  if cfg.profile_dir and ctx.is_coordinator
+                  else contextlib.nullcontext())
+    with profile_cm:
+        last_avg = _epoch_loop(cfg, ctx, mesh, state, train_step,
+                               epoch_batches, start_epoch, metrics, timer)
+
+    log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
+         f"({timer.steps_per_sec_per_chip():.2f} steps/s/chip) on "
+         f"{jax.device_count()} chip(s)")
+    log0("Training completed.")  # parity banner (train.py:128)
+    metrics.close()
+    return last_avg
+
+
+def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_batches,
+                start_epoch, metrics, timer):
+    last_avg = float("nan")
     for epoch in range(start_epoch, cfg.epochs):
         batches = epoch_batches(epoch)
         n_steps = jax.tree.leaves(batches)[0].shape[0]
@@ -141,11 +160,6 @@ def run(cfg: TrainConfig) -> float:
             raise RuntimeError(
                 f"fault injection: --fail-at {cfg.fail_at} triggered")
 
-    log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
-         f"({timer.steps_per_sec_per_chip():.2f} steps/s/chip) on "
-         f"{jax.device_count()} chip(s)")
-    log0("Training completed.")  # parity banner (train.py:128)
-    metrics.close()
     return last_avg
 
 
