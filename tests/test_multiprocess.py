@@ -74,6 +74,18 @@ def test_two_process_training_succeeds(tmp_path):
 
 
 @pytest.mark.slow
+def test_two_process_fsdp_matches_single_process_loss(tmp_path):
+    """FSDP param sharding across process boundaries: the 4-device mesh
+    spans 2 hosts (2 devices each), params sharded fsdp=2 × data=2."""
+    rcs, outs = _run_world(str(tmp_path),
+                           ["--epochs", "1", "--train-batch-size", "64",
+                            "--fsdp", "2"])
+    assert rcs == [0, 0], outs
+    # same deterministic trajectory as every other layout of this workload
+    assert "Epoch 0 finished. Avg loss: 0.6536" in outs[0], outs[0]
+
+
+@pytest.mark.slow
 def test_two_process_failure_aggregates_to_fail(tmp_path):
     rcs, outs = _run_world(str(tmp_path),
                            ["--epochs", "2", "--train-batch-size", "64",

@@ -179,13 +179,14 @@ def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
 
     Column-parallel (shard output dim on tensor): wq/wk/wv/w_gate/w_up.
     Row-parallel (shard input dim on tensor): wo/w_down.
-    Embedding: vocab dim on fsdp, model dim on tensor (tied head makes the
-    output projection row-parallel → psum inserted by XLA).
+    Embedding: vocab dim on fsdp only — sharding its model dim on tensor
+    trips an XLA SPMD-partitioner CHECK crash on the token-gather (observed
+    on the CPU backend, jax 0.9); the layer weights carry the TP work.
     Leading layer dim of stacked weights is never sharded.
     """
     f, t = fsdp_axis, tensor_axis
     return {
-        "embed": P(f, t),
+        "embed": P(f, None),
         "layers": {
             "attn_norm": P(None, None),
             "wq": P(None, f, t),

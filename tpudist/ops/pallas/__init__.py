@@ -1,0 +1,3 @@
+from tpudist.ops.pallas.fused_xent import fused_lm_head_xent
+
+__all__ = ["fused_lm_head_xent"]

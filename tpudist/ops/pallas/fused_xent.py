@@ -1,0 +1,278 @@
+"""Pallas TPU kernel: fused LM-head + cross-entropy.
+
+The measured memory hot spot of the transformer workload is the tied-head
+projection: ``logits = h @ E^T`` materialises a (tokens, vocab) f32 tensor
+(0.5-2 GB at bench shapes) that exists only to be reduced by logsumexp and
+a gather. This kernel streams vocab blocks through VMEM with an online
+logsumexp — logits never touch HBM — and a custom VJP recomputes each
+block in the backward pass (two pallas kernels: dh with vocab innermost,
+dE with tokens innermost).
+
+Forward math per token i:  loss_i = logsumexp_v(h_i·E_v) − h_i·E_{t_i}
+Backward:                  dlogits_iv = (softmax_iv − 1[v = t_i]) · ct_i
+                           dh = dlogits @ E ;  dE = dlogitsᵀ @ h
+
+All reductions/accumulations run in f32 regardless of input dtype.
+Shapes need no special alignment: vocab/token remainders are masked with
+broadcasted iota against the true sizes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG = -1e30
+
+
+def _cdiv(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+def _col_ids(tb: int, vb: int, j: int, block_v: int):
+    """Global vocab column index of each cell in a (tb, vb) logits block."""
+    return jax.lax.broadcasted_iota(jnp.int32, (tb, vb), 1) + j * block_v
+
+
+# ---------------------------------------------------------------- forward
+
+
+def _fwd_kernel(h_ref, emb_ref, tgt_ref, loss_ref, lse_ref,
+                m_ref, s_ref, g_ref, *, vocab: int, block_v: int):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG)
+        s_ref[:] = jnp.zeros_like(s_ref)
+        g_ref[:] = jnp.zeros_like(g_ref)
+
+    h = h_ref[:]
+    logits = jax.lax.dot_general(
+        h, emb_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)          # (tb, vb)
+    tb, vb = logits.shape
+    cols = _col_ids(tb, vb, j, block_v)
+    valid = cols < vocab
+    logits = jnp.where(valid, logits, NEG)
+
+    m_prev = m_ref[:]                                 # (tb, 1)
+    blk_max = jnp.max(logits, axis=1, keepdims=True)
+    m_new = jnp.maximum(m_prev, blk_max)
+    p = jnp.exp(logits - m_new)
+    s_ref[:] = s_ref[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        p, axis=1, keepdims=True)
+    m_ref[:] = m_new
+
+    tgt = tgt_ref[:]                                  # (tb, 1) int32
+    is_gold = (cols == tgt) & valid
+    g_ref[:] += jnp.sum(jnp.where(is_gold, logits, 0.0), axis=1,
+                        keepdims=True)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        lse = m_ref[:] + jnp.log(s_ref[:])
+        lse_ref[:] = lse
+        loss_ref[:] = lse - g_ref[:]
+
+
+def _fwd(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
+         block_t: int, block_v: int, interpret: bool
+         ) -> Tuple[jax.Array, jax.Array]:
+    t, d = h.shape
+    v = emb.shape[0]
+    tgt2 = targets.reshape(t, 1).astype(jnp.int32)
+    grid = (_cdiv(t, block_t), _cdiv(v, block_v))
+
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, vocab=v, block_v=block_v),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_v, d), lambda i, j: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((t, 1), jnp.float32),
+            jax.ShapeDtypeStruct((t, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(h, emb, tgt2)
+    return loss[:, 0], lse
+
+
+# --------------------------------------------------------------- backward
+
+
+def _dlogits(h, emb_blk, tgt, lse, ct, cols, vocab):
+    logits = jax.lax.dot_general(
+        h, emb_blk, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    p = jnp.exp(logits - lse)                         # softmax block
+    valid = cols < vocab
+    is_gold = (cols == tgt) & valid
+    d = (p - is_gold.astype(jnp.float32)) * ct
+    return jnp.where(valid, d, 0.0)
+
+
+def _dh_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, dh_ref, acc_ref, *,
+               vocab: int, block_v: int):
+    j = pl.program_id(1)
+    nj = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    tb = h_ref.shape[0]
+    vb = emb_ref.shape[0]
+    cols = _col_ids(tb, vb, j, block_v)
+    dl = _dlogits(h_ref[:], emb_ref[:], tgt_ref[:], lse_ref[:], ct_ref[:],
+                  cols, vocab)                        # (tb, vb)
+    # zero the out-of-vocab padded rows of the emb block: the matching dl
+    # columns are zero, but 0 × garbage would still poison the contraction
+    row_valid = (jax.lax.broadcasted_iota(jnp.int32, (vb, 1), 0)
+                 + j * block_v) < vocab
+    emb_f = jnp.where(row_valid, emb_ref[:].astype(jnp.float32), 0.0)
+    acc_ref[:] += jax.lax.dot_general(
+        dl, emb_f, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (tb, d)
+
+    @pl.when(j == nj - 1)
+    def _finish():
+        dh_ref[:] = acc_ref[:].astype(dh_ref.dtype)
+
+
+def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
+               vocab: int, block_v: int):
+    j = pl.program_id(0)   # vocab block (outer)
+    i = pl.program_id(1)   # token block (inner)
+    ni = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    tb = h_ref.shape[0]
+    cols = _col_ids(tb, emb_ref.shape[0], j, block_v)
+    dl = _dlogits(h_ref[:], emb_ref[:], tgt_ref[:], lse_ref[:], ct_ref[:],
+                  cols, vocab)                        # (tb, vb)
+    acc_ref[:] += jax.lax.dot_general(
+        dl, h_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)           # (vb, d)
+
+    @pl.when(i == ni - 1)
+    def _finish():
+        de_ref[:] = acc_ref[:].astype(de_ref.dtype)
+
+
+def _bwd(block_t, block_v, interpret, res, ct_loss):
+    h, emb, tgt2, lse = res
+    t, d = h.shape
+    v = emb.shape[0]
+    ct2 = ct_loss.reshape(t, 1).astype(jnp.float32)
+
+    common_in = [h, emb, tgt2, lse, ct2]
+    h_spec_i = pl.BlockSpec((block_t, d), lambda i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+    e_spec_j = pl.BlockSpec((block_v, d), lambda i, j: (j, 0),
+                            memory_space=pltpu.VMEM)
+    col_i = lambda i, j: (i, 0)
+
+    dh = pl.pallas_call(
+        functools.partial(_dh_kernel, vocab=v, block_v=block_v),
+        grid=(_cdiv(t, block_t), _cdiv(v, block_v)),
+        in_specs=[
+            h_spec_i, e_spec_j,
+            pl.BlockSpec((block_t, 1), col_i, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), col_i, memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), col_i, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_t, d), lambda i, j: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((t, d), h.dtype),
+        scratch_shapes=[pltpu.VMEM((block_t, d), jnp.float32)],
+        interpret=interpret,
+    )(*common_in)
+
+    # dE pass: token dim innermost so the (vb, d) accumulator block is
+    # revisited across all token blocks before moving to the next vocab blk
+    de = pl.pallas_call(
+        functools.partial(_de_kernel, vocab=v, block_v=block_v),
+        grid=(_cdiv(v, block_v), _cdiv(t, block_t)),
+        in_specs=[
+            pl.BlockSpec((block_t, d), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_v, d), lambda j, i: (j, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((block_v, d), lambda j, i: (j, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((v, d), emb.dtype),
+        scratch_shapes=[pltpu.VMEM((block_v, d), jnp.float32)],
+        interpret=interpret,
+    )(*common_in)
+
+    return dh, de, None
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _fused(h, emb, targets, block_t, block_v, interpret):
+    loss, _ = _fwd(h, emb, targets, block_t=block_t, block_v=block_v,
+                   interpret=interpret)
+    return loss
+
+
+def _fused_fwd(h, emb, targets, block_t, block_v, interpret):
+    loss, lse = _fwd(h, emb, targets, block_t=block_t, block_v=block_v,
+                     interpret=interpret)
+    t = h.shape[0]
+    tgt2 = targets.reshape(t, 1).astype(jnp.int32)
+    return loss, (h, emb, tgt2, lse.reshape(t, 1))
+
+
+_fused.defvjp(_fused_fwd, _bwd)
+
+
+def fused_lm_head_xent(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
+                       block_t: int = 256, block_v: int = 1280,
+                       interpret: bool = False) -> jax.Array:
+    """Mean cross-entropy of a tied LM head, logits never materialised.
+
+    h: (tokens, d_model) hidden states (bf16 or f32)
+    emb: (vocab, d_model) embedding matrix (tied head)
+    targets: (tokens,) int32 gold token ids
+    Differentiable w.r.t. h and emb. ``interpret=True`` runs the kernels in
+    the pallas interpreter (CPU-testable).
+    """
+    t = h.shape[0]
+    block_t = min(block_t, t)
+    block_v = min(block_v, emb.shape[0])
+    loss = _fused(h, emb, targets, block_t, block_v, interpret)
+    return jnp.mean(loss)
