@@ -164,7 +164,7 @@ def _dh_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, dh_ref, acc_ref, *,
 
 
 def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
-               vocab: int, block_v: int):
+               vocab: int, block_v: int, tokens: int, block_t: int):
     j = pl.program_id(0)   # vocab block (outer)
     i = pl.program_id(1)   # token block (inner)
     ni = pl.num_programs(1)
@@ -177,8 +177,20 @@ def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
     cols = _col_ids(tb, emb_ref.shape[0], j, block_v)
     dl = _dlogits(h_ref[:], emb_ref[:], tgt_ref[:], lse_ref[:], ct_ref[:],
                   cols, vocab)                        # (tb, vb)
+    h_f = h_ref[:].astype(jnp.float32)
+    if tokens % block_t:
+        # Mask padded token rows (trace-time guard: aligned shapes skip it):
+        # the last block's rows of h/ct/lse beyond the true token count are
+        # undefined on real TPU (only interpret mode zero-fills) and must
+        # not be contracted into the accumulator. dl is zeroed via select
+        # (not multiply — the garbage may be inf/nan) and h likewise,
+        # mirroring the vocab-col mask.
+        rows_valid = (jax.lax.broadcasted_iota(jnp.int32, (tb, 1), 0)
+                      + i * block_t) < tokens
+        dl = jnp.where(rows_valid, dl, 0.0)
+        h_f = jnp.where(rows_valid, h_f, 0.0)
     acc_ref[:] += jax.lax.dot_general(
-        dl, h_ref[:].astype(jnp.float32), (((0,), (0,)), ((), ())),
+        dl, h_f, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)           # (vb, d)
 
     @pl.when(i == ni - 1)
@@ -218,7 +230,8 @@ def _bwd(block_t, block_v, interpret, res, ct_loss):
     # dE pass: token dim innermost so the (vb, d) accumulator block is
     # revisited across all token blocks before moving to the next vocab blk
     de = pl.pallas_call(
-        functools.partial(_de_kernel, vocab=v, block_v=block_v),
+        functools.partial(_de_kernel, vocab=v, block_v=block_v,
+                          tokens=t, block_t=block_t),
         grid=(_cdiv(v, block_v), _cdiv(t, block_t)),
         in_specs=[
             pl.BlockSpec((block_t, d), lambda j, i: (i, 0),
