@@ -1,16 +1,29 @@
-"""Headline benchmark: synthetic transformer training steps/sec/chip.
+"""Headline benchmark: synthetic transformer training throughput + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The reference publishes no numbers (BASELINE.md: "published: {}"), so
-``vs_baseline`` is reported as 1.0 by convention with the absolute value
-carrying the signal. The workload is BASELINE.json config #5 shaped to one
-chip: Llama-style block stack (4 layers, 2048 hidden, bf16) full train step
-(fwd+bwd+Adam) under jit, batch sized to keep the MXU busy.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
+``vs_baseline`` is the ratio of this run's tokens/s/chip to the best value
+recorded by any prior round's ``BENCH_r*.json`` in the repo root (1.0 when
+none exists), so regressions are visible in the artifact itself. ``detail``
+carries an analytic MFU: FLOPs/token = 6·N_params + 6·L·d·s (dense matmuls
+fwd+bwd ≈ 6N, plus causal attention scores/values), against the chip's bf16
+peak. The workload is BASELINE.json config #5 shaped to one chip:
+Llama-style block stack (4 layers, 2048 hidden, bf16) full train step
+(fwd+bwd+Adam) under jit.
+
+``--fused-xent`` benches the pallas fused LM-head variant
+(tpudist.ops.pallas.fused_xent): slightly lower tokens/s at batch 24 (two
+extra logits-block matmuls in its recomputing backward) but it removes the
+(tokens, vocab) logits tensor from HBM entirely — batch 96+ trains on one
+v5e, where the plain path OOMs at 48.
 """
 
 from __future__ import annotations
 
+import argparse
+import glob
 import json
+import re
+import statistics
 import time
 
 import jax
@@ -19,28 +32,86 @@ from tpudist import data, engine
 from tpudist.config import (DataConfig, ParallelConfig, TrainConfig,
                             flagship_model_config)
 
+# bf16 peak TFLOP/s by device kind (dense); None → MFU not reported
+PEAK_TFLOPS = [
+    (re.compile(r"v5 ?lite|v5e", re.I), 197.0),
+    (re.compile(r"v5p", re.I), 459.0),
+    (re.compile(r"v4", re.I), 275.0),
+    (re.compile(r"v6|trillium", re.I), 918.0),
+]
+
+
+def chip_peak_tflops(device_kind: str):
+    for pat, peak in PEAK_TFLOPS:
+        if pat.search(device_kind):
+            return peak
+    return None
+
+
+def train_flops_per_token(n_params: int, cfg: TrainConfig) -> float:
+    """6·N for the dense matmuls (fwd 2N + bwd 4N) plus causal attention:
+    per layer fwd = 2·(2·s·d)·0.5 (QKᵀ + PV, halved by causality), ×3 for
+    fwd+bwd."""
+    m = cfg.model
+    s = m.max_seq_len
+    return 6.0 * n_params + 6.0 * m.n_layers * m.d_model * s
+
+
+def best_prior_bench() -> float | None:
+    """Best tokens/s/chip across prior rounds' BENCH_r*.json, anchored to
+    this script's directory (cwd-independent)."""
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            val = rec.get("parsed", rec).get("value")
+            if isinstance(val, (int, float)) and (best is None or val > best):
+                best = float(val)
+        except Exception:
+            continue
+    return best
+
 
 def main() -> None:
     from tpudist.utils import maybe_force_platform
     maybe_force_platform()
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--fused-xent", action="store_true",
+                   help="bench the pallas fused LM-head variant")
+    p.add_argument("--batch-per-chip", type=int, default=None)
+    p.add_argument("--iters", type=int, default=60)
+    args = p.parse_args()
+
     n_dev = jax.device_count()
     seq = 512
-    # 24/chip: measured sweet spot on v5e (69k tok/s/chip; 16→65k, 28→67k,
-    # 30+ degrades under memory pressure)
-    batch = 24 * n_dev
+    # 24/chip: measured sweet spot on v5e for the plain path (69k tok/s/chip;
+    # 16→65k, 28→67k, 30+ degrades under memory pressure). The fused head
+    # removes the logits tensor from HBM so it runs big-batch; pairing it
+    # with remat keeps the backbone activations within HBM at batch 96.
+    per_chip = args.batch_per_chip or (96 if args.fused_xent else 24)
+    batch = per_chip * n_dev
     cfg = TrainConfig(
         batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
+        fused_xent=args.fused_xent, remat=args.fused_xent,
         data=DataConfig(n_samples=batch),
         model=flagship_model_config(max_seq_len=seq),
         parallel=ParallelConfig(data=-1))
 
     from tpudist.parallel import build_mesh
+    from tpudist.parallel import sharding as shd
     mesh = build_mesh(cfg.parallel)
     state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
     step = engine.make_train_step(cfg, mesh)
     toks = data.make_synthetic_tokens(batch, seq + 1, cfg.model.vocab_size,
                                       seed=0)
-    batch_t = (toks,)
+    # place the batch once: steady-state training streams input during the
+    # previous step, so per-step host transfer must not pollute the timing
+    batch_t = shd.put_batch(mesh, (toks,))
 
     # warmup: trace + compile + first execution (fence via host transfer —
     # on tunneled/remote PJRT backends block_until_ready can return before
@@ -49,26 +120,48 @@ def main() -> None:
         state, loss = step(state, batch_t)
     float(loss)
 
-    iters = 20
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, batch_t)
-    float(loss)
-    dt = time.perf_counter() - t0
+    # timing in groups: per-group fencing keeps the async queue honest, and
+    # the 20-step group amortises the fence's pipeline drain (~100ms on the
+    # tunneled backend — a 5-step group inflates step time ~8%)
+    group, n_groups = 20, max(2, args.iters // 20)
+    group_ms = []
+    for _ in range(n_groups):
+        t0 = time.perf_counter()
+        for _ in range(group):
+            state, loss = step(state, batch_t)
+        float(loss)
+        group_ms.append((time.perf_counter() - t0) * 1000 / group)
 
+    step_ms = statistics.median(group_ms)
     toks_per_step = batch * seq
-    tok_s_chip = toks_per_step * iters / dt / n_dev
+    tok_s_chip = toks_per_step / (step_ms / 1000) / n_dev
+
+    device_kind = jax.devices()[0].device_kind
+    peak = chip_peak_tflops(device_kind)
+    achieved_tflops = (train_flops_per_token(n_params, cfg) * tok_s_chip
+                       / 1e12)
+    mfu_pct = round(100 * achieved_tflops / peak, 2) if peak else None
+
+    prior = best_prior_bench()
     print(json.dumps({
         "metric": "transformer_train_tokens_per_sec_per_chip",
         "value": round(tok_s_chip, 1),
         "unit": "tokens/s/chip",
-        "vs_baseline": 1.0,
+        "vs_baseline": round(tok_s_chip / prior, 4) if prior else 1.0,
         "detail": {
-            "device": jax.devices()[0].device_kind,
+            "device": device_kind,
             "n_devices": n_dev,
             "global_batch": batch, "seq_len": seq,
-            "steps_per_sec_per_chip": round(iters / dt / n_dev, 4),
-            "step_time_ms": round(1000 * dt / iters, 2),
+            "lm_head": "fused_xent" if args.fused_xent else "plain",
+            "n_params": n_params,
+            "mfu_pct": mfu_pct,
+            "achieved_tflops_per_chip": round(achieved_tflops, 1),
+            "peak_tflops": peak,
+            "steps_per_sec_per_chip": round(1000 / step_ms / n_dev, 4),
+            "step_time_ms": round(step_ms, 2),
+            "step_time_ms_min": round(min(group_ms), 2),
+            "step_time_ms_max": round(max(group_ms), 2),
+            "prior_best_tok_s_chip": prior,
         },
     }))
 

@@ -4,9 +4,13 @@
 # Reference mechanism (slurm_train.sbatch:11-45): derive MASTER_ADDR from the
 # SLURM nodelist, srun one launcher per node inside the container, write
 # job_status.txt. TPU-native mechanism: create a queued-resources TPU slice,
-# run the workload on every worker with --worker=all (jax.distributed
-# auto-discovers the coordinator from TPU metadata — no MASTER_ADDR dance),
-# aggregate per-worker verdicts into a GCS object the CI poller reads.
+# probe that the provisioned slice really has the requested chip count (the
+# analogue of the reference CI's scontrol probe, ci:115-119 — on SLURM the
+# cluster exists and is probed; on TPU the slice is created to order, so the
+# probe verifies delivery instead), run the workload on every worker with
+# --worker=all (jax.distributed auto-discovers the coordinator from TPU
+# metadata — no MASTER_ADDR dance), aggregate per-worker verdicts into a GCS
+# object the CI poller reads, and gate the collective-bandwidth sweep.
 #
 # Usage:
 #   ACCELERATOR_TYPE=v5p-16 RUNTIME_VERSION=v2-alpha-tpuv5 \
@@ -16,13 +20,20 @@
 # Required env:
 #   TPU_NAME            name for the queued resource / TPU VM
 #   ZONE, PROJECT       GCP placement
-#   ACCELERATOR_TYPE    e.g. v5p-16 (topology is probed from this — the
-#                       analogue of the reference CI's scontrol probe)
+#   ACCELERATOR_TYPE    e.g. v5p-16 (expected chip count derives from this)
 #   GCS_VERDICT         gs:// URI for the machine-readable verdict
 # Optional:
 #   RUNTIME_VERSION     TPU software version (default v2-alpha-tpuv5)
-#   IMAGE               docker image to run (default: bare python on TPU-VM)
+#   IMAGE               docker image to run (default: install this repo's
+#                       package on each worker and run bare python)
 #   TIMEOUT_S           provisioning+run timeout (default 1800)
+#   RUN_SWEEP=1         run the gated bandwidth sweep after training
+#   SWEEP_MIN_PCT       sweep gate threshold (default 90, BASELINE.md)
+#   GCS_SWEEP_VERDICT   verdict URI for the sweep gate
+#                       (default ${GCS_VERDICT}.sweep)
+#
+# Exit codes: 0 ok; 1 workload/probe failure; 2 workload ok but sweep gate
+# failed; 124 provisioning timeout.
 
 set -euo pipefail
 
@@ -33,7 +44,21 @@ set -euo pipefail
 : "${GCS_VERDICT:?set GCS_VERDICT}"
 RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5}"
 TIMEOUT_S="${TIMEOUT_S:-1800}"
-EXTRA_FLAGS=("$@")
+SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
+GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
+
+# shell-quote every extra workload flag: flags with spaces/metacharacters
+# must survive the ssh --command round-trip verbatim
+EXTRA_Q=""
+for f in "$@"; do
+  EXTRA_Q+=" $(printf '%q' "$f")"
+done
+
+tpu_ssh() {  # tpu_ssh <worker> <command...>
+  local worker="$1"; shift
+  gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
+    --zone "$ZONE" --project "$PROJECT" --worker="$worker" --command "$*"
+}
 
 cleanup() {
   # idempotent teardown — a red run must not leak a reserved slice
@@ -42,6 +67,10 @@ cleanup() {
     --zone "$ZONE" --project "$PROJECT" --quiet --force 2>/dev/null || true
 }
 trap cleanup EXIT
+
+fail_verdict() {
+  echo -n fail | gsutil cp - "$GCS_VERDICT" || true
+}
 
 echo "creating queued resource $TPU_NAME ($ACCELERATOR_TYPE) ..."
 gcloud compute tpus queued-resources create "$TPU_NAME" \
@@ -60,51 +89,103 @@ while :; do
   echo "queued-resource state: $state"
   case "$state" in
     ACTIVE) break ;;
-    FAILED|SUSPENDED) echo "provisioning failed: $state"; exit 1 ;;
+    FAILED|SUSPENDED) echo "provisioning failed: $state"; fail_verdict; exit 1 ;;
   esac
   if (( SECONDS > deadline )); then
-    echo "timeout waiting for TPU slice"; exit 124
+    echo "timeout waiting for TPU slice"; fail_verdict; exit 124
   fi
   sleep 10
 done
 
-# Run the workload on EVERY worker; jax.distributed.initialize() discovers
-# coordinator + process count from TPU metadata. Any worker's nonzero exit
-# fails the ssh command (srun semantics, slurm_train.sbatch:34-44).
-#
-# With IMAGE set, the containerized workload runs; otherwise the bare
-# TPU-VM python runs the pip-installed package. The container does NOT get
-# a gs:// verdict path — the image has no gsutil, and the verdict is this
-# wrapper's job anyway (same division of labor as the reference: the sbatch
-# wrapper writes job_status.txt from the workload's exit code,
-# slurm_train.sbatch:33-45).
+# ---- expected chip count from the accelerator type -------------------------
+# vXp-N / vX-N name TensorCores (2 per chip, 1 jax device per chip);
+# v5litepod-N / v5e-N / v6e-N name chips directly.
+SUFFIX="${ACCELERATOR_TYPE##*-}"
+case "$ACCELERATOR_TYPE" in
+  v5litepod-*|v5e-*|v6e-*) EXPECTED_CHIPS="$SUFFIX" ;;
+  *) EXPECTED_CHIPS=$((SUFFIX / 2)) ;;
+esac
+
+# ---- workload delivery -----------------------------------------------------
 if [ -n "${IMAGE:-}" ]; then
-  REMOTE_CMD="sudo docker pull $IMAGE && \
-    sudo docker run --rm --privileged --network host $IMAGE \
-      python3 -m tpudist.train ${EXTRA_FLAGS[*]:-}"
+  # /tmp is mounted so the sweep's JSONL artifact lands on the host VM
+  RUN_PREFIX="sudo docker run --rm --privileged --network host -v /tmp:/tmp $IMAGE"
+  tpu_ssh all "sudo docker pull $IMAGE"
 else
-  REMOTE_CMD="python3 -m tpudist.train ${EXTRA_FLAGS[*]:-}"
+  # bare path: nothing on a fresh TPU-VM has the package — ship this repo
+  # as an sdist-style tarball and pip-install it on every worker
+  PKG_TGZ=$(mktemp /tmp/tpudist_pkg.XXXXXX.tgz)
+  tar -czf "$PKG_TGZ" -C "$(dirname "$0")/.." pyproject.toml tpudist
+  gcloud compute tpus tpu-vm scp "$PKG_TGZ" "$TPU_NAME:tpudist_pkg.tgz" \
+    --zone "$ZONE" --project "$PROJECT" --worker=all
+  tpu_ssh all "rm -rf ~/tpudist_src && mkdir -p ~/tpudist_src && \
+    tar xzf ~/tpudist_pkg.tgz -C ~/tpudist_src && \
+    pip3 install --quiet --user ~/tpudist_src"
+  rm -f "$PKG_TGZ"
+  RUN_PREFIX=""
 fi
 
+# ---- live topology probe ---------------------------------------------------
+# Before training: initialize distributed across ALL workers and assert the
+# global device count matches what the accelerator type promises. A short
+# multihost program also proves rendezvous works; failing here yields a
+# clean 'fail' verdict instead of a mesh-shape crash mid-training.
+PROBE="import jax, sys
+jax.distributed.initialize()
+n = jax.device_count()
+ok = n == int(sys.argv[1])
+print(f'probe: {n} global devices, expected {sys.argv[1]}, ok={ok}')
+sys.exit(0 if ok else 1)"
 set +e
-gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
-  --zone "$ZONE" --project "$PROJECT" --worker=all \
-  --command "$REMOTE_CMD"
+tpu_ssh all "$RUN_PREFIX python3 -c $(printf '%q' "$PROBE") $EXPECTED_CHIPS"
+PROBE_RC=$?
+set -e
+if [ $PROBE_RC -ne 0 ]; then
+  echo "❌ slice probe failed: provisioned slice does not match $ACCELERATOR_TYPE"
+  fail_verdict
+  exit 1
+fi
+
+# ---- the distributed training job ------------------------------------------
+# Any worker's nonzero exit fails the ssh command (srun semantics,
+# slurm_train.sbatch:34-44). The verdict is this wrapper's job, from the
+# workload's exit code (same division of labor as the reference sbatch).
+set +e
+tpu_ssh all "$RUN_PREFIX python3 -m tpudist.train$EXTRA_Q"
 RC=$?
 set -e
 
-if [ $RC -eq 0 ]; then
-  echo "✅ distributed TPU job succeeded"
-  if [ "${RUN_SWEEP:-0}" = "1" ]; then
-    # measure while the slice is still alive (teardown runs on EXIT)
-    gcloud compute tpus tpu-vm ssh "$TPU_NAME" \
-      --zone "$ZONE" --project "$PROJECT" --worker=0 \
-      --command "python3 -m tpudist.bench.sweep --kinds all_reduce" \
-      | tee sweep.jsonl || true
-  fi
-  echo -n success | gsutil cp - "$GCS_VERDICT"
-else
+if [ $RC -ne 0 ]; then
   echo "❌ distributed TPU job failed (rc=$RC)"
-  echo -n fail | gsutil cp - "$GCS_VERDICT" || true
+  fail_verdict
+  exit $RC
 fi
-exit $RC
+echo "✅ distributed TPU job succeeded"
+echo -n success | gsutil cp - "$GCS_VERDICT"
+
+# ---- gated bandwidth sweep (while the slice is alive) ----------------------
+SWEEP_RC=0
+if [ "${RUN_SWEEP:-0}" = "1" ]; then
+  set +e
+  # ALL workers run the sweep (the collectives span the whole pod; the
+  # sweep does its own distributed init) but only process 0 writes the
+  # JSONL. Banners on stdout never touch the artifact; the gate's exit
+  # code is the signal and THIS wrapper publishes the sweep verdict (the
+  # container image carries no gsutil — same division of labor as the
+  # main verdict). timeout: a wedged collective must not eat the slice.
+  tpu_ssh all "timeout 900 $RUN_PREFIX python3 -m tpudist.bench.sweep \
+    --kinds all_reduce --min-pct-peak $SWEEP_MIN_PCT \
+    --out /tmp/sweep.jsonl"
+  SWEEP_RC=$?
+  gcloud compute tpus tpu-vm scp "$TPU_NAME:/tmp/sweep.jsonl" sweep.jsonl \
+    --zone "$ZONE" --project "$PROJECT" --worker=0 || true
+  set -e
+  if [ $SWEEP_RC -ne 0 ]; then
+    echo "❌ bandwidth sweep below ${SWEEP_MIN_PCT}% of ring peak (rc=$SWEEP_RC)"
+    echo -n fail | gsutil cp - "$GCS_SWEEP_VERDICT" || true
+    exit 2
+  fi
+  echo "✅ bandwidth sweep passed the ${SWEEP_MIN_PCT}% gate"
+  echo -n success | gsutil cp - "$GCS_SWEEP_VERDICT"
+fi
+exit 0

@@ -75,3 +75,45 @@ def test_sweep_sizes():
     sizes = sweep_sizes(1, 1024)
     assert sizes[0] == 1 << 20 and sizes[-1] == 1 << 30
     assert all(b == a * 4 for a, b in zip(sizes, sizes[1:]))
+
+
+def test_sweep_gate_logic():
+    from tpudist.bench.sweep import gate
+    recs = [{"kind": "all_reduce", "pct_of_ring_peak": 95.0},
+            {"kind": "all_reduce", "pct_of_ring_peak": 40.0}]
+    assert gate(recs, 90)["ok"] is True          # best bucket carries
+    assert gate(recs, 96)["ok"] is False
+    # nothing measurable (single device / unknown chip) is NOT a pass
+    none_rec = [{"kind": "all_reduce", "pct_of_ring_peak": None}]
+    assert gate(none_rec, 90)["ok"] is None
+    mixed = recs + [{"kind": "all_gather", "pct_of_ring_peak": 50.0}]
+    g = gate(mixed, 90)
+    assert g["ok"] is False and "all_gather" in g["reason"]
+
+
+def test_sweep_cli_gate_and_out(tmp_path):
+    """CPU mesh has no known ring peak -> gate not applicable -> exit 1
+    (absent evidence is a failure, like the reference's missing status
+    file); --min-pct-peak 0 disables the gate -> exit 0 and a clean JSONL
+    artifact."""
+    import json
+    from tpudist.bench import sweep
+    out = tmp_path / "sweep.jsonl"
+    rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--out", str(out)])
+    assert rc == 1
+    rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--min-pct-peak", "0", "--out", str(out)])
+    assert rc == 0
+    lines = out.read_text().strip().splitlines()
+    assert lines and all(json.loads(ln)["kind"] == "all_reduce"
+                         for ln in lines)
+
+
+def test_sweep_verdict_file(tmp_path):
+    from tpudist.bench import sweep
+    v = tmp_path / "sweep_status.txt"
+    rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--verdict-path", str(v)])
+    assert rc == 1
+    assert v.read_text() == "fail"

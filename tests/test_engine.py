@@ -94,3 +94,23 @@ def test_bfloat16_compute_converges():
     mesh = build_mesh(cfg.parallel, devices=jax.devices()[:1])
     _, losses = _run_epochs(cfg, mesh, n_epochs=3)
     assert losses[-1] < 0.5 * losses[0]
+
+
+def test_transformer_pure_dp_shard_map_path(devices8):
+    """Regression (r2 review): the transformer loss must trace inside the
+    fully-manual shard_map DP body — the logits sharding constraint is a
+    jit-path-only optimisation and crashed every multi-device pure-DP
+    transformer run when it leaked in."""
+    cfg = TrainConfig(
+        batch_size=8, lr=1e-3, seed=0, dtype="float32",
+        data=DataConfig(n_samples=8),
+        model=ModelConfig(name="transformer", vocab_size=64, n_layers=1,
+                          d_model=32, n_heads=2, n_kv_heads=2, d_ff=64,
+                          max_seq_len=16),
+        parallel=ParallelConfig(data=8))
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step = engine.make_train_step(cfg, mesh)
+    toks = data.make_synthetic_tokens(8, 17, 64, seed=0)
+    state, loss = step(state, (toks,))
+    assert np.isfinite(float(loss))

@@ -97,6 +97,26 @@ def test_remat_and_chunked_xent_match_plain():
         np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), g_base, g_ch)
 
 
+def test_fused_xent_matches_plain():
+    """The pallas fused LM-head loss (interpret mode on CPU) is numerically
+    the same computation as the whole-logits path — loss and grads agree."""
+    from tpudist import data
+    toks = data.make_synthetic_tokens(4, 17, 97, seed=0)
+    p = transformer.init(jax.random.PRNGKey(0), TINY_TF)
+    base = transformer.loss_fn(p, toks, TINY_TF, dtype=jnp.float32)
+    fused = transformer.loss_fn(p, toks, TINY_TF, dtype=jnp.float32,
+                                fused_xent=True)
+    np.testing.assert_allclose(float(fused), float(base), rtol=1e-5)
+    g_base = jax.grad(lambda q: transformer.loss_fn(
+        q, toks, TINY_TF, dtype=jnp.float32))(p)
+    g_f = jax.grad(lambda q: transformer.loss_fn(
+        q, toks, TINY_TF, dtype=jnp.float32, fused_xent=True))(p)
+    jax.tree.map(lambda a, b: np.testing.assert_allclose(
+        np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6), g_base, g_f)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        transformer.loss_fn(p, toks, TINY_TF, fused_xent=True, xent_chunks=4)
+
+
 def test_transformer_loss_decreases_under_adam():
     import optax
     from tpudist import data

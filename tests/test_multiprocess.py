@@ -59,7 +59,7 @@ def test_two_process_training_succeeds(tmp_path):
                            ["--epochs", "2", "--train-batch-size", "64"])
     assert rcs == [0, 0], outs
     # rank 0 logs, rank 1 is silent (parity: reference rank-0 gating)
-    assert "Epoch 0 finished. Avg loss: 0.6536" in outs[0], outs[0]
+    assert "Epoch  1 finished. Avg loss: 0.6536" in outs[0], outs[0]
     assert "Training completed." in outs[0]
     assert "Epoch" not in outs[1], outs[1]
     # determinism across process counts: same loss as the 1-process run
@@ -82,7 +82,7 @@ def test_two_process_fsdp_matches_single_process_loss(tmp_path):
                             "--fsdp", "2"])
     assert rcs == [0, 0], outs
     # same deterministic trajectory as every other layout of this workload
-    assert "Epoch 0 finished. Avg loss: 0.6536" in outs[0], outs[0]
+    assert "Epoch  1 finished. Avg loss: 0.6536" in outs[0], outs[0]
 
 
 @pytest.mark.slow

@@ -24,8 +24,8 @@ def test_happy_path_contract(tmp_path, capsys, monkeypatch):
                    verdict_path=vpath, monkeypatch=monkeypatch)
     assert rc == 0
     # parity stdout lines (reference train.py:121,128)
-    assert "Epoch 0 finished. Avg loss:" in out
-    assert "Epoch 1 finished. Avg loss:" in out
+    assert "Epoch  1 finished. Avg loss:" in out
+    assert "Epoch  2 finished. Avg loss:" in out
     assert "Training completed." in out
     with open(vpath) as f:
         assert f.read() == verdict_lib.SUCCESS
@@ -58,7 +58,7 @@ def test_resume_continues(tmp_path, capsys, monkeypatch):
                              "--save-dir", save])
     assert rc == 0
     assert "Resumed from epoch 1" in out2
-    assert "Epoch 2 finished" in out2 and "Epoch 0 finished" not in out2
+    assert "Epoch  3 finished" in out2 and "Epoch  1 finished" not in out2
 
 
 def test_unknown_flags_tolerated(tmp_path, capsys, monkeypatch):

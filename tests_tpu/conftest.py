@@ -1,0 +1,30 @@
+"""Real-hardware test lane (VERDICT r1 #8).
+
+Unlike ``tests/`` (which forces the virtual 8-device CPU mesh), this suite
+runs on the actual TPU chip and exercises what the CPU lane structurally
+cannot: the Mosaic compile path of the pallas kernels (``interpret=False``),
+real-chip bf16 numerics, and a bench smoke. Run it on any TPU host with:
+
+    python -m pytest tests_tpu/ -q
+
+The whole suite is skipped when no TPU backend is available, so a plain
+``pytest`` on a CPU box stays green.
+"""
+
+import jax
+import pytest
+
+
+def _has_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
+def pytest_collection_modifyitems(config, items):
+    if _has_tpu():
+        return
+    skip = pytest.mark.skip(reason="no TPU backend available")
+    for item in items:
+        item.add_marker(skip)

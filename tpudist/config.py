@@ -72,6 +72,7 @@ class TrainConfig:
     dtype: str = "float32"        # compute dtype: float32 | bfloat16
     remat: bool = False           # checkpoint transformer layers
     xent_chunks: int = 0          # stream LM head+loss over N seq chunks
+    fused_xent: bool = False      # pallas fused LM head+loss (no HBM logits)
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
@@ -112,6 +113,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--xent-chunks", type=int, default=0,
                    help="stream the LM head + cross-entropy over N sequence "
                         "chunks instead of materialising full logits")
+    p.add_argument("--fused-xent", action="store_true",
+                   help="compute the LM head + cross-entropy with the fused "
+                        "pallas kernel (logits never reach HBM); runs in "
+                        "the pallas interpreter off-TPU")
     p.add_argument("--n-samples", type=int, default=2000)
     p.add_argument("--n-features", type=int, default=20)
     # transformer shape (defaults = BASELINE.json config #5: 4 layers, 2k hidden)
@@ -146,6 +151,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         dtype=args.dtype,
         remat=args.remat,
         xent_chunks=args.xent_chunks,
+        fused_xent=args.fused_xent,
         fail_at=args.fail_at,
         log_every=args.log_every,
         profile_dir=args.profile_dir,

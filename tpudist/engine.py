@@ -50,11 +50,16 @@ def _compute_dtype(cfg: TrainConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
-def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None) -> Callable:
+def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
+                 constrain_logits: bool = False) -> Callable:
     """(params, batch) -> scalar loss, for the configured model.
 
     With a mesh whose ``context`` axis is >1, the transformer loss runs
-    context-parallel (sequence sharded, ring attention)."""
+    context-parallel (sequence sharded, ring attention).
+
+    ``constrain_logits`` is only legal (and only needed) under the
+    jit+shardings train path — a NamedSharding constraint inside the
+    fully-manual shard_map DP path is an error."""
     model = get_model(cfg.model.name)
     dt = _compute_dtype(cfg)
     if cfg.model.name == "mlp":
@@ -64,17 +69,32 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None) -> Callable:
     if cp:
         cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt,
                                         remat=cfg.remat,
-                                        xent_chunks=cfg.xent_chunks)
+                                        xent_chunks=cfg.xent_chunks,
+                                        fused_xent=cfg.fused_xent)
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch
             return cp_loss(params, tokens)
         return loss
 
+    logits_sh = None
+    if mesh is not None and constrain_logits:
+        # Batch dims follow the batch layout; the vocab dim rides the tensor
+        # axis so the tied-head backward (dE = dlogitsᵀ·h, vocab-sharded
+        # embed grad) consumes dlogits natively — without this the
+        # partitioner demands a batch→vocab reshard of the (b,s,v) cotangent
+        # it can only satisfy by full rematerialisation (dp+fsdp+tensor).
+        vocab_axis = ("tensor" if cfg.model.vocab_size
+                      % mesh.shape.get("tensor", 1) == 0 else None)
+        logits_sh = NamedSharding(
+            mesh, P(("data", "fsdp"), None, vocab_axis))
+
     def loss(params, batch):
         tokens = batch[0] if isinstance(batch, tuple) else batch
         return model.loss_fn(params, tokens, cfg.model, dtype=dt,
-                             remat=cfg.remat, xent_chunks=cfg.xent_chunks)
+                             remat=cfg.remat, xent_chunks=cfg.xent_chunks,
+                             fused_xent=cfg.fused_xent,
+                             logits_sharding=logits_sh)
     return loss
 
 
@@ -165,10 +185,15 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     Chooses the explicit-psum shard_map path for pure-DP meshes, else the
     jit+shardings path. Loss returned is the global mean.
     """
-    loss_fn = make_loss_fn(cfg, mesh)
     tx = make_optimizer(cfg)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     pure_dp = all(axis_sizes[a] == 1 for a in ("fsdp", "tensor", "context"))
+    # the logits constraint belongs to the jit+shardings path only — inside
+    # the shard_map DP body every mesh axis is manual and a NamedSharding
+    # constraint is rejected at trace time
+    loss_fn = make_loss_fn(cfg, mesh,
+                           constrain_logits=not (pure_dp
+                                                 and axis_sizes["data"] > 1))
 
     def sgd_update(state: TrainState, loss, grads):
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -206,8 +231,17 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     st_sh = state_shardings(cfg, mesh)
 
     def body(state: TrainState, batch):
-        loss, grads = _microbatch(loss_fn, state.params, batch,
+        # Pin the weights to their layout *inside* the traced body: the
+        # transpose of a sharding constraint constrains the cotangent, so
+        # the scan-transpose gradient accumulation of the stacked layer
+        # weights keeps the params' sharding instead of letting the
+        # partitioner pick one it then can't reconcile (spmd_partitioner
+        # "involuntary full rematerialization" on the grad add_any).
+        params = jax.lax.with_sharding_constraint(state.params,
+                                                  st_sh.params)
+        loss, grads = _microbatch(loss_fn, params, batch,
                                   cfg.grad_accum_steps)
+        grads = jax.lax.with_sharding_constraint(grads, st_sh.params)
         return sgd_update(state, loss, grads)
 
     jitted = jax.jit(body, in_shardings=(st_sh, None),

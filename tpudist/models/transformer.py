@@ -34,15 +34,20 @@ Params = Dict
 
 
 def precompute_rope(seq_len: int, head_dim: int, theta: float = 10000.0,
-                    offset=0):
+                    offset=0, positions=None):
     """RoPE cos/sin tables of shape (seq_len, head_dim//2), f32.
 
     ``offset`` may be a traced scalar (context-parallel shards pass
     ``axis_index * s_local`` for absolute positions), so it is added to a
-    static arange rather than baked into it."""
+    static arange rather than baked into it. ``positions`` (a (seq_len,)
+    array, may be traced) overrides the arithmetic entirely — zigzag
+    context shards hold two non-adjacent chunks of the sequence."""
     inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2,
                                            dtype=jnp.float32) / head_dim))
-    t = jnp.arange(seq_len, dtype=jnp.float32) + offset
+    if positions is not None:
+        t = positions.astype(jnp.float32)
+    else:
+        t = jnp.arange(seq_len, dtype=jnp.float32) + offset
     freqs = jnp.outer(t, inv_freq)
     return jnp.cos(freqs), jnp.sin(freqs)
 
@@ -140,14 +145,16 @@ def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
 
 def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                   dtype=jnp.bfloat16, attn_impl=_attention,
-                  rope_offset=0, remat: bool = False) -> jax.Array:
+                  rope_offset=0, rope_positions=None,
+                  remat: bool = False) -> jax.Array:
     """Backbone forward: tokens (batch, seq) -> final-norm hidden states
     (batch, seq, d_model) in ``dtype``. ``remat`` checkpoints each layer
     (recompute activations in backward — HBM for FLOPs, the standard TPU
     trade when memory, not compute, limits batch size)."""
     s = tokens.shape[1]
     hd = cfg.d_model // cfg.n_heads
-    cos, sin = precompute_rope(s, hd, cfg.rope_theta, offset=rope_offset)
+    cos, sin = precompute_rope(s, hd, cfg.rope_theta, offset=rope_offset,
+                               positions=rope_positions)
     x = params["embed"].astype(dtype)[tokens]
 
     def body(x, lp):
@@ -161,14 +168,17 @@ def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
 
 def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
           dtype=jnp.bfloat16, attn_impl=_attention,
-          rope_offset=0, remat: bool = False) -> jax.Array:
+          rope_offset=0, rope_positions=None,
+          remat: bool = False) -> jax.Array:
     """Forward: tokens (batch, seq) int32 -> logits (batch, seq, vocab) f32.
 
     ``attn_impl`` lets context-parallel callers substitute ring attention;
-    ``rope_offset`` gives each context shard its absolute positions.
+    ``rope_offset`` / ``rope_positions`` give each context shard its
+    absolute positions.
     """
     x = hidden_states(params, tokens, cfg, dtype=dtype, attn_impl=attn_impl,
-                      rope_offset=rope_offset, remat=remat)
+                      rope_offset=rope_offset, rope_positions=rope_positions,
+                      remat=remat)
     # tied output head
     return (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
 
@@ -230,15 +240,43 @@ def _chunked_head_xent(embed: jax.Array, h: jax.Array, targets: jax.Array,
     return total / n_chunks
 
 
+def _fused_head_xent(embed: jax.Array, h: jax.Array,
+                     targets: jax.Array) -> jax.Array:
+    """Tied head + cross-entropy via the pallas kernel
+    (tpudist.ops.pallas.fused_xent): logits never touch HBM at all —
+    strictly less memory traffic than the chunked jnp path. Kernels run in
+    the interpreter off-TPU so the same code path is CPU-testable."""
+    from tpudist.ops.pallas.fused_xent import fused_lm_head_xent
+    b, s, d = h.shape
+    interpret = jax.default_backend() != "tpu"
+    return fused_lm_head_xent(h.reshape(b * s, d), embed,
+                              targets.reshape(b * s), interpret=interpret)
+
+
 def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
             dtype=jnp.bfloat16, remat: bool = False,
-            xent_chunks: int = 0) -> jax.Array:
+            xent_chunks: int = 0, fused_xent: bool = False,
+            logits_sharding=None) -> jax.Array:
     """Causal next-token cross-entropy over the synthetic token stream.
 
-    ``xent_chunks`` > 0 streams the LM head + loss over that many sequence
-    chunks (memory-bound win at large batch×seq×vocab); 0 keeps the simple
-    whole-logits path."""
+    ``fused_xent`` routes the LM head + loss through the pallas kernel
+    (no logits in HBM); ``xent_chunks`` > 0 streams the head over that many
+    sequence chunks with jnp + checkpoint (memory-bound win at large
+    batch×seq×vocab); 0/off keeps the simple whole-logits path.
+
+    ``logits_sharding`` (a NamedSharding) pins the (b, s, vocab) logits —
+    and, through the constraint's transpose, their cotangent — to the batch
+    layout. Without it the SPMD partitioner can demand a vocab-sharded
+    dlogits for the tied-embed grad matmul while the xent backward produces
+    it batch-sharded, and falls back to full rematerialisation of the
+    tensor (dp+fsdp+tensor layouts)."""
+    if fused_xent and xent_chunks:
+        raise ValueError("--fused-xent and --xent-chunks are mutually "
+                         "exclusive LM-head strategies")
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if fused_xent:
+        h = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
+        return _fused_head_xent(params["embed"].astype(dtype), h, targets)
     if xent_chunks:
         if targets.shape[1] % xent_chunks:
             # erroring beats silently materialising the full logits tensor
@@ -250,46 +288,67 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
         return _chunked_head_xent(params["embed"].astype(dtype), h, targets,
                                   xent_chunks)
     logits = apply(params, inputs, cfg, dtype=dtype, remat=remat)
+    if logits_sharding is not None:
+        logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
     return _xent(logits, targets)
 
 
 def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
                     dtype=jnp.bfloat16, remat: bool = False,
-                    xent_chunks: int = 0):
-    """Context-parallel loss: sequence sharded over ``axis``, attention via
-    ring attention (tpudist.ops.ring_attention), RoPE offset per shard.
+                    xent_chunks: int = 0, fused_xent: bool = False):
+    """Context-parallel loss: sequence sharded over ``axis`` in the zigzag
+    layout (each shard holds one early + one late chunk — balanced causal
+    work), attention via ring attention (tpudist.ops.ring_attention), RoPE
+    from per-shard absolute positions.
 
     Only the ``axis`` mesh dimension is manualized (shard_map axis_names);
     data/fsdp/tensor sharding of batch and params continues to flow through
     the SPMD partitioner outside/inside the manual region. The token shift
-    happens BEFORE sharding so no halo exchange is needed; (seq_len) of the
-    shifted inputs must divide by the axis size.
+    and the zigzag permutation happen BEFORE sharding, so no halo exchange
+    is needed and the loss (a token mean) needs no inverse permutation;
+    (seq_len) of the shifted inputs must divide by 2 × the axis size.
     """
-    from tpudist.ops.ring_attention import ring_attention_local
+    from tpudist.ops.ring_attention import ring_attention_local, \
+        zigzag_permute, zigzag_positions
+
+    if fused_xent and xent_chunks:
+        raise ValueError("--fused-xent and --xent-chunks are mutually "
+                         "exclusive LM-head strategies")
+    n_ctx = mesh.shape[axis]
 
     def loss(params: Params, tokens: jax.Array) -> jax.Array:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        inputs = zigzag_permute(inputs, n_ctx)
+        targets = zigzag_permute(targets, n_ctx)
 
         def body(params, inputs, targets):
             s_local = inputs.shape[1]
-            off = lax.axis_index(axis) * s_local
+            pos = zigzag_positions(lax.axis_index(axis), s_local, n_ctx)
 
             def attn(q, k, v):
-                return ring_attention_local(q, k, v, axis, causal=True)
+                return ring_attention_local(q, k, v, axis, causal=True,
+                                            layout="zigzag")
 
-            if xent_chunks:
+            if fused_xent:
+                h = hidden_states(params, inputs, cfg, dtype=dtype,
+                                  attn_impl=attn, rope_positions=pos,
+                                  remat=remat)
+                local = _fused_head_xent(params["embed"].astype(dtype), h,
+                                         targets)
+            elif xent_chunks:
                 if s_local % xent_chunks:
                     raise ValueError(
                         f"local sequence {s_local} not divisible by "
                         f"xent_chunks={xent_chunks}")
                 h = hidden_states(params, inputs, cfg, dtype=dtype,
-                                  attn_impl=attn, rope_offset=off,
+                                  attn_impl=attn, rope_positions=pos,
                                   remat=remat)
                 local = _chunked_head_xent(params["embed"].astype(dtype), h,
                                            targets, xent_chunks)
             else:
                 logits = apply(params, inputs, cfg, dtype=dtype,
-                               attn_impl=attn, rope_offset=off, remat=remat)
+                               attn_impl=attn, rope_positions=pos,
+                               remat=remat)
                 local = _xent(logits, targets)
             return lax.pmean(local, axis)
 

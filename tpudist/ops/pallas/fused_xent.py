@@ -149,13 +149,20 @@ def _dh_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, dh_ref, acc_ref, *,
     cols = _col_ids(tb, vb, j, block_v)
     dl = _dlogits(h_ref[:], emb_ref[:], tgt_ref[:], lse_ref[:], ct_ref[:],
                   cols, vocab)                        # (tb, vb)
-    # zero the out-of-vocab padded rows of the emb block: the matching dl
-    # columns are zero, but 0 × garbage would still poison the contraction
-    row_valid = (jax.lax.broadcasted_iota(jnp.int32, (vb, 1), 0)
-                 + j * block_v) < vocab
-    emb_f = jnp.where(row_valid, emb_ref[:].astype(jnp.float32), 0.0)
+    emb = emb_ref[:]
+    if vocab % block_v:
+        # zero the out-of-vocab padded rows of the emb block (trace-time
+        # guard: aligned vocab skips it): the matching dl columns are zero,
+        # but 0 × garbage would still poison the contraction. Zeroed in the
+        # native dtype — an f32 copy of the block doubles its VMEM.
+        row_valid = (jax.lax.broadcasted_iota(jnp.int32, (vb, 1), 0)
+                     + j * block_v) < vocab
+        emb = jnp.where(row_valid, emb, jnp.zeros_like(emb))
+    # dl is cast to the operand dtype so the contraction runs native on the
+    # MXU with an f32 accumulator — the same schedule XLA derives for the
+    # unfused bf16 head (d/dh of a bf16 matmul casts the f32 cotangent down)
     acc_ref[:] += jax.lax.dot_general(
-        dl, emb_f, (((1,), (0,)), ((), ())),
+        dl.astype(emb.dtype), emb, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)           # (tb, d)
 
     @pl.when(j == nj - 1)
@@ -177,7 +184,7 @@ def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
     cols = _col_ids(tb, emb_ref.shape[0], j, block_v)
     dl = _dlogits(h_ref[:], emb_ref[:], tgt_ref[:], lse_ref[:], ct_ref[:],
                   cols, vocab)                        # (tb, vb)
-    h_f = h_ref[:].astype(jnp.float32)
+    h = h_ref[:]
     if tokens % block_t:
         # Mask padded token rows (trace-time guard: aligned shapes skip it):
         # the last block's rows of h/ct/lse beyond the true token count are
@@ -188,9 +195,9 @@ def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
         rows_valid = (jax.lax.broadcasted_iota(jnp.int32, (tb, 1), 0)
                       + i * block_t) < tokens
         dl = jnp.where(rows_valid, dl, 0.0)
-        h_f = jnp.where(rows_valid, h_f, 0.0)
+        h = jnp.where(rows_valid, h, jnp.zeros_like(h))
     acc_ref[:] += jax.lax.dot_general(
-        dl, h_f, (((0,), (0,)), ((), ())),
+        dl.astype(h.dtype), h, (((0,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)           # (vb, d)
 
     @pl.when(i == ni - 1)
@@ -198,7 +205,11 @@ def _de_kernel(h_ref, emb_ref, tgt_ref, lse_ref, ct_ref, de_ref, acc_ref, *,
         de_ref[:] = acc_ref[:].astype(de_ref.dtype)
 
 
-def _bwd(block_t, block_v, interpret, res, ct_loss):
+def _bwd(block_t, block_v, block_v_bwd, interpret, res, ct_loss):
+    # The backward kernels carry a (block_v, d) f32 accumulator (dE) or an
+    # f32 dl block — a smaller vocab block than the forward keeps them
+    # under the scoped-VMEM limit at bench shapes (d=2048).
+    block_v = block_v_bwd
     h, emb, tgt2, lse = res
     t, d = h.shape
     v = emb.shape[0]
@@ -255,14 +266,14 @@ def _bwd(block_t, block_v, interpret, res, ct_loss):
     return dh, de, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-def _fused(h, emb, targets, block_t, block_v, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _fused(h, emb, targets, block_t, block_v, block_v_bwd, interpret):
     loss, _ = _fwd(h, emb, targets, block_t=block_t, block_v=block_v,
                    interpret=interpret)
     return loss
 
 
-def _fused_fwd(h, emb, targets, block_t, block_v, interpret):
+def _fused_fwd(h, emb, targets, block_t, block_v, block_v_bwd, interpret):
     loss, lse = _fwd(h, emb, targets, block_t=block_t, block_v=block_v,
                      interpret=interpret)
     t = h.shape[0]
@@ -275,6 +286,7 @@ _fused.defvjp(_fused_fwd, _bwd)
 
 def fused_lm_head_xent(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
                        block_t: int = 256, block_v: int = 1280,
+                       block_v_bwd: int = 320,
                        interpret: bool = False) -> jax.Array:
     """Mean cross-entropy of a tied LM head, logits never materialised.
 
@@ -282,10 +294,13 @@ def fused_lm_head_xent(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
     emb: (vocab, d_model) embedding matrix (tied head)
     targets: (tokens,) int32 gold token ids
     Differentiable w.r.t. h and emb. ``interpret=True`` runs the kernels in
-    the pallas interpreter (CPU-testable).
+    the pallas interpreter (CPU-testable). ``block_v_bwd`` is the vocab
+    block of the backward kernels, smaller than the forward's because they
+    carry (block_v, d)-shaped f32 state in VMEM.
     """
     t = h.shape[0]
     block_t = min(block_t, t)
     block_v = min(block_v, emb.shape[0])
-    loss = _fused(h, emb, targets, block_t, block_v, interpret)
+    block_v_bwd = min(block_v_bwd, emb.shape[0])
+    loss = _fused(h, emb, targets, block_t, block_v, block_v_bwd, interpret)
     return jnp.mean(loss)

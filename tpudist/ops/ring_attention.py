@@ -3,10 +3,30 @@
 Long-context extension (the reference has no sequence dimension at all,
 SURVEY.md §5.7; this is TPU-first design for scale): the sequence is sharded
 over the mesh's ``context`` axis; each device computes flash-style online
-softmax for its local query block while key/value blocks rotate around the
-ring via ``lax.ppermute`` — n_ctx hops overlap compute with neighbour ICI
-transfers, memory per device is O(S/n), and no device ever materialises the
-full S×S score matrix.
+softmax for its local query chunks while key/value blocks rotate around the
+ring via ``lax.ppermute``. Memory per device is O(S/n) and no device ever
+materialises the full S×S score matrix. Each hop's ppermute is issued
+*before* the current block is consumed, so the neighbour ICI transfer has no
+data dependence on the hop's compute and XLA's scheduler can overlap them.
+
+Causal load balance — the ``zigzag`` layout (default): contiguous sequence
+sharding under a causal mask is pathologically imbalanced (rank 0's queries
+mask out every remote block; rank n-1 needs them all — and the synchronous
+ring makes everyone wait for the busiest rank). Instead the sequence is
+split into 2n chunks and rank r holds the PAIR (r, 2n-1-r) — one early
+chunk, one late chunk. Under causality exactly two of the four chunk-pairs
+per remote hop are live, and both are *fully* unmasked:
+
+  * q_high × k_low — always (the high chunk 2n-1-r is later than every low
+    chunk src < n).
+  * q_low × k_low(src)  when src < r, else  q_high × k_high(src) — the
+    "diagonal" pair, strictly ordered either way.
+
+Only the local block needs masks (intra-chunk causal triangles). Every rank
+therefore computes the same 2 chunk-matmuls per hop (3 locally) — ~2× fewer
+attention FLOPs than consume-everything and perfectly balanced. The loss is
+a token-mean, so the zigzag permutation needs no inverse on the loss path;
+callers that need outputs in sequence order apply ``zigzag_inverse``.
 
 Math: standard online-softmax accumulation (numerator, denominator, running
 max) in f32; a block fully masked by causality contributes exp(-1e30)=0
@@ -16,6 +36,7 @@ rather than -inf arithmetic (NaN-safe).
 from __future__ import annotations
 
 import functools
+from typing import List
 
 import jax
 import jax.numpy as jnp
@@ -26,86 +47,241 @@ from jax.sharding import PartitionSpec as P
 NEG = -1e30
 
 
+# ------------------------------------------------------------- zigzag layout
+
+
+def zigzag_order(n: int) -> List[int]:
+    """Chunk ids (of 2n sequence chunks) in on-device order: rank r holds
+    [r, 2n-1-r], concatenated over ranks."""
+    out: List[int] = []
+    for r in range(n):
+        out += [r, 2 * n - 1 - r]
+    return out
+
+
+def zigzag_permute(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Reorder a sequence axis into zigzag layout: after a contiguous
+    n-way shard, rank r's slice holds chunks (r, 2n-1-r) of the original."""
+    s = x.shape[axis]
+    if s % (2 * n):
+        raise ValueError(f"sequence length {s} not divisible by 2*n={2 * n} "
+                         "(zigzag context layout)")
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    return jnp.concatenate([chunks[i] for i in zigzag_order(n)], axis=axis)
+
+
+def zigzag_inverse(x: jax.Array, n: int, axis: int = 1) -> jax.Array:
+    """Inverse of :func:`zigzag_permute`."""
+    order = zigzag_order(n)
+    inv = [0] * len(order)
+    for pos, cid in enumerate(order):
+        inv[cid] = pos
+    chunks = jnp.split(x, 2 * n, axis=axis)
+    return jnp.concatenate([chunks[i] for i in inv], axis=axis)
+
+
+def zigzag_positions(me, s_local: int, n: int) -> jax.Array:
+    """Absolute token positions of rank ``me``'s local zigzag slice
+    (chunks me and 2n-1-me), for RoPE. ``me`` may be traced
+    (``lax.axis_index``)."""
+    c = s_local // 2
+    ar = jnp.arange(c)
+    return jnp.concatenate([me * c + ar, (2 * n - 1 - me) * c + ar])
+
+
+# --------------------------------------------------------- online softmax
+
+
+def _update(scores, vf, num, den, mx):
+    """Fold one (b,h,q,k) score block into the (num, den, mx) state."""
+    blk_max = jnp.max(scores, axis=-1)                    # (b,h,q)
+    new_mx = jnp.maximum(mx, blk_max)
+    corr = jnp.exp(mx - new_mx)
+    p = jnp.exp(scores - new_mx[..., None])               # (b,h,q,k)
+    num = num * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
+    den = den * corr + jnp.sum(p, axis=-1)
+    return num, den, new_mx
+
+
 def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
-                         axis: str, *, causal: bool = True) -> jax.Array:
+                         axis: str, *, causal: bool = True,
+                         layout: str = "zigzag",
+                         unroll: int | bool = False) -> jax.Array:
     """Per-shard ring attention; call INSIDE shard_map.
 
     q: local block ``(batch, s_local, heads, head_dim)``; k, v may have
     fewer (grouped-query) kv heads — GQA expansion happens inside the block
     compute, so only the COMPACT kv blocks travel the ring. The sequence
-    dim is sharded over ``axis``. n-1 hops total: the local block is
-    consumed before the first rotation and the last block is not forwarded.
+    dim is sharded over ``axis``; with ``layout="zigzag"`` (causal only)
+    the caller must have permuted the sequence with :func:`zigzag_permute`.
     Returns the local output block ``(batch, s_local, heads, head_dim)``.
     """
+    if layout not in ("zigzag", "contig"):
+        raise ValueError(f"unknown ring layout {layout!r}")
+    # n=1 is a degenerate ring (no remote hops): the zigzag schedule's
+    # peeled final hop would re-consume the local block, so fall back to
+    # the contig path, which handles it as a single masked local consume
+    if layout == "zigzag" and causal and lax.axis_size(axis) > 1:
+        return _ring_zigzag(q, k, v, axis, unroll=unroll)
+    return _ring_contig(q, k, v, axis, causal=causal, unroll=unroll)
+
+
+def _expand_gqa(x: jax.Array, rep: int) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    return jnp.repeat(xf, rep, axis=2) if rep != 1 else xf
+
+
+def _ring_contig(q, k, v, axis: str, *, causal: bool,
+                 unroll: int | bool = False) -> jax.Array:
+    """Contiguous-shard ring: every rank consumes every kv block (the only
+    option without causality; under causality prefer zigzag)."""
     n = lax.axis_size(axis)
     me = lax.axis_index(axis)
     b, s, h, d = q.shape
-    kv = k.shape[2]
-    rep = h // kv
+    rep = h // k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
     qf = q.astype(jnp.float32)
-
-    q_pos = me * s + jnp.arange(s)  # absolute positions of local queries
+    q_pos = me * s + jnp.arange(s)
     perm = [(j, (j + 1) % n) for j in range(n)]
 
     def consume(k_cur, v_cur, src, num, den, mx):
-        """Online-softmax update with the block whose global index is src."""
-        kf = k_cur.astype(jnp.float32)
-        vf = v_cur.astype(jnp.float32)
-        if rep != 1:
-            kf = jnp.repeat(kf, rep, axis=2)
-            vf = jnp.repeat(vf, rep, axis=2)
+        kf = _expand_gqa(k_cur, rep)
+        vf = _expand_gqa(v_cur, rep)
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
         if causal:
             k_pos = src * s + jnp.arange(s)
             mask = q_pos[:, None] >= k_pos[None, :]
             scores = jnp.where(mask[None, None], scores, NEG)
-        blk_max = jnp.max(scores, axis=-1)                    # (b,h,q)
-        new_mx = jnp.maximum(mx, blk_max)
-        corr = jnp.exp(mx - new_mx)
-        p = jnp.exp(scores - new_mx[..., None])               # (b,h,q,k)
-        num = num * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
-        den = den * corr + jnp.sum(p, axis=-1)
-        return num, den, new_mx
+        return _update(scores, vf, num, den, mx)
 
-    num0 = jnp.zeros((b, h, s, d), jnp.float32)
-    den0 = jnp.zeros((b, h, s), jnp.float32)
-    mx0 = jnp.full((b, h, s), NEG, jnp.float32)
-    # hop 0: the local block, no transfer
-    num, den, mx = consume(k, v, me, num0, den0, mx0)
+    num = jnp.zeros((b, h, s, d), jnp.float32)
+    den = jnp.zeros((b, h, s), jnp.float32)
+    mx = jnp.full((b, h, s), NEG, jnp.float32)
 
     def step(i, carry):
         k_cur, v_cur, num, den, mx = carry
-        # rotate FIRST (ICI neighbour transfer of compact kv), then consume
-        k_cur = lax.ppermute(k_cur, axis, perm=perm)
-        v_cur = lax.ppermute(v_cur, axis, perm=perm)
+        # issue the rotation FIRST: the transfer of the NEXT block has no
+        # dependence on this hop's compute, so they overlap
+        k_nxt = lax.ppermute(k_cur, axis, perm=perm)
+        v_nxt = lax.ppermute(v_cur, axis, perm=perm)
         num, den, mx = consume(k_cur, v_cur, (me - i) % n, num, den, mx)
-        return k_cur, v_cur, num, den, mx
+        return k_nxt, v_nxt, num, den, mx
 
-    _, _, num, den, _ = lax.fori_loop(1, n, step, (k, v, num, den, mx))
+    k_l, v_l, num, den, mx = lax.fori_loop(0, n - 1, step,
+                                           (k, v, num, den, mx),
+                                           unroll=unroll)
+    # last block: consume only, nothing left to rotate
+    num, den, _ = consume(k_l, v_l, (me - (n - 1)) % n, num, den, mx)
 
     out = num / jnp.maximum(den, 1e-30)[..., None]            # (b,h,q,d)
     return out.transpose(0, 2, 1, 3).astype(q.dtype)          # (b,q,h,d)
 
 
+def _ring_zigzag(q, k, v, axis: str, *,
+                 unroll: int | bool = False) -> jax.Array:
+    """Zigzag-layout causal ring (see module docstring for the schedule)."""
+    n = lax.axis_size(axis)
+    me = lax.axis_index(axis)
+    b, s, h, d = q.shape
+    if s % 2:
+        raise ValueError("zigzag layout needs an even local sequence length")
+    c = s // 2
+    rep = h // k.shape[2]
+    scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    qf = q.astype(jnp.float32)
+    q_lo, q_hi = qf[:, :c], qf[:, c:]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    tri = (jnp.arange(c)[:, None] >= jnp.arange(c)[None, :])[None, None]
+
+    def scores_of(q_chunk, k_chunk, mask=None):
+        sc = jnp.einsum("bqhd,bkhd->bhqk", q_chunk, k_chunk) * scale
+        if mask is not None:
+            sc = jnp.where(mask, sc, NEG)
+        return sc
+
+    def zero_state():
+        return (jnp.zeros((b, h, c, d), jnp.float32),
+                jnp.zeros((b, h, c), jnp.float32),
+                jnp.full((b, h, c), NEG, jnp.float32))
+
+    # --- local block (the only masked hop): 3 live chunk pairs ---
+    kf = _expand_gqa(k, rep)
+    vf = _expand_gqa(v, rep)
+    k_lo, k_hi = kf[:, :c], kf[:, c:]
+    v_lo, v_hi = vf[:, :c], vf[:, c:]
+    lo = _update(scores_of(q_lo, k_lo, tri), v_lo, *zero_state())
+    hi = _update(scores_of(q_hi, k_lo), v_lo, *zero_state())
+    hi = _update(scores_of(q_hi, k_hi, tri), v_hi, *hi)
+
+    def consume_remote(src, k_cur, v_cur, lo, hi):
+        """Two unmasked chunk pairs: q_hi×k_lo always; the diagonal pair
+        goes to q_lo (src < me) or q_hi (src > me) — chunk operands and the
+        target state are selected by predicate, the matmuls run once."""
+        kf = _expand_gqa(k_cur, rep)
+        vf = _expand_gqa(v_cur, rep)
+        k_lo, k_hi = kf[:, :c], kf[:, c:]
+        v_lo, v_hi = vf[:, :c], vf[:, c:]
+        hi = _update(scores_of(q_hi, k_lo), v_lo, *hi)
+
+        pred = src < me
+        q_sel = jnp.where(pred, q_lo, q_hi)
+        k_sel = jnp.where(pred, k_lo, k_hi)
+        v_sel = jnp.where(pred, v_lo, v_hi)
+        st = jax.tree.map(lambda a, b: jnp.where(pred, a, b), lo, hi)
+        st = _update(scores_of(q_sel, k_sel), v_sel, *st)
+        lo = jax.tree.map(lambda new, old: jnp.where(pred, new, old), st, lo)
+        hi = jax.tree.map(lambda new, old: jnp.where(pred, old, new), st, hi)
+        return lo, hi
+
+    def step(i, carry):
+        k_cur, v_cur, lo, hi = carry
+        k_nxt = lax.ppermute(k_cur, axis, perm=perm)   # overlaps consume
+        v_nxt = lax.ppermute(v_cur, axis, perm=perm)
+        lo, hi = consume_remote((me - i) % n, k_cur, v_cur, lo, hi)
+        return k_nxt, v_nxt, lo, hi
+
+    # hops 1..n-1; the local block was consumed above, so rotate first and
+    # peel the last hop (consume only, nothing left to forward)
+    k1 = lax.ppermute(k, axis, perm=perm)
+    v1 = lax.ppermute(v, axis, perm=perm)
+    k_l, v_l, lo, hi = lax.fori_loop(1, n - 1, step, (k1, v1, lo, hi),
+                                     unroll=unroll)
+    lo, hi = consume_remote((me - (n - 1)) % n, k_l, v_l, lo, hi)
+
+    def finish(num, den, mx):
+        out = num / jnp.maximum(den, 1e-30)[..., None]        # (b,h,c,d)
+        return out.transpose(0, 2, 1, 3)                      # (b,c,h,d)
+
+    return jnp.concatenate([finish(*lo), finish(*hi)],
+                           axis=1).astype(q.dtype)
+
+
 def make_ring_attention(mesh: Mesh, axis: str = "context", *,
-                        causal: bool = True):
+                        causal: bool = True, layout: str = "zigzag"):
     """Standalone jitted ring attention on globally (seq-)sharded arrays.
 
     q, k, v: ``(batch, seq, heads, head_dim)`` with seq sharded over
-    ``axis``. Used directly by tests and by context-parallel model code.
+    ``axis``. With the zigzag layout the permutation/inverse are applied
+    here, so inputs and outputs are in natural sequence order. Used
+    directly by tests and by context-parallel model code.
     """
+    n = mesh.shape[axis]
     spec = P(None, axis, None, None)
+    zig = layout == "zigzag" and causal and n > 1
 
     @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def f(q, k, v):
-        return ring_attention_local(q, k, v, axis, causal=causal)
+        return ring_attention_local(q, k, v, axis, causal=causal,
+                                    layout=layout)
 
     jf = jax.jit(f)
 
     def apply(q, k, v):
+        if zig:
+            q, k, v = (zigzag_permute(x, n) for x in (q, k, v))
         sh = NamedSharding(mesh, spec)
-        return jf(jax.device_put(q, sh), jax.device_put(k, sh),
-                  jax.device_put(v, sh))
+        out = jf(jax.device_put(q, sh), jax.device_put(k, sh),
+                 jax.device_put(v, sh))
+        return zigzag_inverse(out, n) if zig else out
     return apply

@@ -52,14 +52,42 @@ def write_final_verdict(path: str, ok: bool) -> None:
         _write(path, SUCCESS if ok else FAIL)
 
 
-def aggregate_ok(local_ok: bool) -> bool:
+def aggregate_ok(local_ok: bool,
+                 timeout_s: float | None = None) -> bool:
     """AND-reduce success over all processes (srun semantics: one bad worker
-    fails the job). Uses a device all-reduce — if a worker died before this
-    point the collective itself fails, which is also a correct 'fail'."""
+    fails the job).
+
+    Failure mode, honestly: if a worker died before reaching this point,
+    the allgather does NOT promptly fail — it typically HANGS until the
+    distributed runtime's own timeout. The bounded wait here (default 120s,
+    ``TPUDIST_AGGREGATE_TIMEOUT_S``) converts that hang into a local
+    ``False`` so this process can still write a ``fail`` verdict; the
+    launcher's outer timeout (launch_tpu.sh TIMEOUT_S) remains the backstop
+    of last resort. The abandoned collective thread may linger until the
+    runtime gives up — acceptable for a process that is about to exit."""
     if jax.process_count() == 1:
         return local_ok
+    import os
+    import threading
+
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
-    flag = multihost_utils.process_allgather(
-        jnp.asarray([1 if local_ok else 0], jnp.int32))
-    return bool(flag.min() == 1)
+
+    if timeout_s is None:
+        timeout_s = float(os.environ.get("TPUDIST_AGGREGATE_TIMEOUT_S", 120))
+
+    result: list = []
+
+    def gather():
+        flag = multihost_utils.process_allgather(
+            jnp.asarray([1 if local_ok else 0], jnp.int32))
+        result.append(bool(flag.min() == 1))
+
+    t = threading.Thread(target=gather, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if not result:
+        print(f"tpudist: verdict aggregation timed out after {timeout_s}s "
+              "(a peer likely died before the barrier) -> fail")
+        return False
+    return result[0]
