@@ -76,8 +76,9 @@ def best_prior_bench() -> float | None:
 
 
 def main() -> None:
-    from tpudist.utils import maybe_force_platform
+    from tpudist.utils import maybe_force_platform, tune_tpu
     maybe_force_platform()
+    tune_tpu()
 
     p = argparse.ArgumentParser()
     p.add_argument("--fused-xent", action="store_true",
@@ -88,10 +89,11 @@ def main() -> None:
 
     n_dev = jax.device_count()
     seq = 512
-    # 24/chip: measured sweet spot on v5e for the plain path (69k tok/s/chip;
-    # 16→65k, 28→67k, 30+ degrades under memory pressure). The fused head
-    # removes the logits tensor from HBM so it runs big-batch; pairing it
-    # with remat keeps the backbone activations within HBM at batch 96.
+    # 24/chip: measured plateau on v5e for the plain path (81k tok/s/chip
+    # with unrolled layers + tuned scoped VMEM; 20→75.3k, 28→75.0k,
+    # 32→73.8k pre-tuning). The fused head removes the logits tensor from
+    # HBM so it runs big-batch; pairing it with remat keeps the backbone
+    # activations within HBM at batch 96.
     per_chip = args.batch_per_chip or (96 if args.fused_xent else 24)
     batch = per_chip * n_dev
     cfg = TrainConfig(

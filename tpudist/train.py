@@ -181,8 +181,9 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_batches,
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    from tpudist.utils import maybe_force_platform
+    from tpudist.utils import maybe_force_platform, tune_tpu
     maybe_force_platform()
+    tune_tpu()
     cfg = parse_args(argv)
     verdict_path = os.environ.get("TPUDIST_VERDICT_PATH")
     ok = False

@@ -1,3 +1,3 @@
-from tpudist.utils.platform import maybe_force_platform
+from tpudist.utils.platform import maybe_force_platform, tune_tpu
 
-__all__ = ["maybe_force_platform"]
+__all__ = ["maybe_force_platform", "tune_tpu"]
