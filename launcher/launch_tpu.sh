@@ -44,6 +44,7 @@ set -euo pipefail
 : "${GCS_VERDICT:?set GCS_VERDICT}"
 RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5}"
 TIMEOUT_S="${TIMEOUT_S:-1800}"
+POLL_S="${POLL_S:-10}"   # provisioning poll interval (tests shrink it)
 SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
 
@@ -94,7 +95,7 @@ while :; do
   if (( SECONDS > deadline )); then
     echo "timeout waiting for TPU slice"; fail_verdict; exit 124
   fi
-  sleep 10
+  sleep "$POLL_S"
 done
 
 # ---- expected chip count from the accelerator type -------------------------

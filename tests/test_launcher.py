@@ -1,0 +1,189 @@
+"""Launcher (L3) control-flow tests against stub gcloud/gsutil binaries.
+
+The real launcher can only run against live GCP, but every decision it
+makes — provisioning poll/timeout, the slice probe, srun-style failure
+propagation, verdict publication, the sweep gate, idempotent teardown — is
+local shell logic. These tests run ``launcher/launch_tpu.sh`` with a fake
+``gcloud``/``gsutil`` on PATH that scripts the remote side and records
+every call, mirroring how the reference's sbatch logic was only ever
+exercised by its CI shell (reference ci:115-181); here it runs in pytest.
+"""
+
+import os
+import stat
+import subprocess
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+GCLOUD_STUB = r"""#!/usr/bin/env bash
+# scripted gcloud: behavior is driven by STUB_DIR state files
+log() { echo "gcloud $*" >> "$STUB_DIR/calls.log"; }
+log "$@"
+case "$*" in
+  *"queued-resources create"*)
+    exit "${STUB_CREATE_RC:-0}" ;;
+  *"queued-resources describe"*)
+    # first N describes report PROVISIONING, then the scripted state
+    n=$(cat "$STUB_DIR/describe_n" 2>/dev/null || echo 0)
+    echo $((n+1)) > "$STUB_DIR/describe_n"
+    if [ "$n" -lt "${STUB_PENDING_POLLS:-1}" ]; then
+      echo "PROVISIONING"
+    else
+      echo "${STUB_STATE:-ACTIVE}"
+    fi
+    exit 0 ;;
+  *"queued-resources delete"*)
+    touch "$STUB_DIR/deleted"
+    exit 0 ;;
+  *"tpu-vm scp"*)
+    exit 0 ;;
+  *"tpu-vm ssh"*)
+    # route by payload: probe / train / sweep
+    if [[ "$*" == *"jax.distributed.initialize"* ]]; then
+      exit "${STUB_PROBE_RC:-0}"
+    elif [[ "$*" == *"tpudist.train"* ]]; then
+      exit "${STUB_TRAIN_RC:-0}"
+    elif [[ "$*" == *"tpudist.bench.sweep"* ]]; then
+      exit "${STUB_SWEEP_RC:-0}"
+    fi
+    exit 0 ;;
+esac
+exit 0
+"""
+
+GSUTIL_STUB = r"""#!/usr/bin/env bash
+echo "gsutil $*" >> "$STUB_DIR/calls.log"
+if [ "$1" = "cp" ] && [ "$2" = "-" ]; then
+  # record verdict writes: gs://path -> file named after the last component
+  dest="${3##*/}"
+  cat > "$STUB_DIR/verdict_${dest}"
+fi
+exit 0
+"""
+
+
+@pytest.fixture()
+def stub_env(tmp_path):
+    bin_dir = tmp_path / "bin"
+    bin_dir.mkdir()
+    for name, body in (("gcloud", GCLOUD_STUB), ("gsutil", GSUTIL_STUB)):
+        p = bin_dir / name
+        p.write_text(body)
+        p.chmod(p.stat().st_mode | stat.S_IEXEC)
+    stub_dir = tmp_path / "state"
+    stub_dir.mkdir()
+    env = dict(
+        os.environ,
+        PATH=f"{bin_dir}:{os.environ['PATH']}",
+        STUB_DIR=str(stub_dir),
+        TPU_NAME="t", ZONE="z", PROJECT="p",
+        ACCELERATOR_TYPE="v5litepod-16",
+        GCS_VERDICT="gs://b/runs/1/job_status.txt",
+        TIMEOUT_S="30",
+        POLL_S="0",
+    )
+    return env, stub_dir
+
+
+def launch(env, *flags, cwd=None):
+    return subprocess.run(
+        [str(REPO / "launcher" / "launch_tpu.sh"), *flags],
+        env=env, cwd=cwd or env["STUB_DIR"], capture_output=True, text=True,
+        timeout=120)
+
+
+def verdict(stub_dir, name="job_status.txt"):
+    p = stub_dir / f"verdict_{name}"
+    return p.read_text() if p.exists() else None
+
+
+def test_happy_path_success_verdict_and_teardown(stub_env):
+    env, stub = stub_env
+    r = launch(env, "--epochs", "2")
+    assert r.returncode == 0, r.stderr
+    assert verdict(stub) == "success"
+    assert (stub / "deleted").exists(), "teardown must always run"
+    calls = (stub / "calls.log").read_text()
+    assert "jax.distributed.initialize" in calls   # probe ran before train
+    assert "tpudist.train" in calls
+
+
+def test_extra_flags_with_spaces_survive_quoting(stub_env):
+    env, stub = stub_env
+    r = launch(env, "--save-dir", "dir with spaces")
+    assert r.returncode == 0, r.stderr
+    calls = (stub / "calls.log").read_text()
+    assert r"dir\ with\ spaces" in calls or "'dir with spaces'" in calls
+
+
+def test_workload_failure_writes_fail_and_propagates_rc(stub_env):
+    env, stub = stub_env
+    env["STUB_TRAIN_RC"] = "3"
+    r = launch(env)
+    assert r.returncode == 3
+    assert verdict(stub) == "fail"
+    assert (stub / "deleted").exists()
+
+
+def test_probe_mismatch_fails_before_training(stub_env):
+    env, stub = stub_env
+    env["STUB_PROBE_RC"] = "1"
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    assert "tpudist.train" not in (stub / "calls.log").read_text(), \
+        "training must not start on a bad slice"
+
+
+def test_provisioning_failure_and_timeout(stub_env):
+    env, stub = stub_env
+    env["STUB_STATE"] = "FAILED"
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+
+    env2, stub2 = stub_env
+    env2 = dict(env2, STUB_PENDING_POLLS="1000", TIMEOUT_S="0")
+    r = launch(env2)
+    assert r.returncode == 124
+    assert verdict(stub2) == "fail"
+
+
+def test_sweep_gate_failure_exits_2_with_sweep_verdict(stub_env):
+    env, stub = stub_env
+    env["RUN_SWEEP"] = "1"
+    env["STUB_SWEEP_RC"] = "1"
+    r = launch(env)
+    assert r.returncode == 2
+    assert verdict(stub) == "success"                  # training DID pass
+    assert verdict(stub, "job_status.txt.sweep") == "fail"
+
+
+def test_sweep_gate_success_writes_sweep_verdict(stub_env):
+    env, stub = stub_env
+    env["RUN_SWEEP"] = "1"
+    r = launch(env)
+    assert r.returncode == 0
+    assert verdict(stub, "job_status.txt.sweep") == "success"
+
+
+def test_bare_path_installs_package_on_workers(stub_env):
+    env, stub = stub_env
+    r = launch(env)
+    assert r.returncode == 0
+    calls = (stub / "calls.log").read_text()
+    assert "tpu-vm scp" in calls and "pip3 install" in calls, \
+        "bare path must ship + install the package (r1 advisor finding)"
+
+
+def test_image_path_skips_install_uses_docker(stub_env):
+    env, stub = stub_env
+    env["IMAGE"] = "ghcr.io/x/y:ci-1"
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    calls = (stub / "calls.log").read_text()
+    assert "docker pull ghcr.io/x/y:ci-1" in calls
+    assert "pip3 install" not in calls

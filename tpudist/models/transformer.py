@@ -98,11 +98,22 @@ def init(key: jax.Array, cfg: ModelConfig) -> Params:
     }
 
 
+_BLOCKWISE_MIN_SEQ = 2048
+_BLOCKWISE_CHUNK = 1024
+
+
 def _attention(q, k, v, *, causal: bool = True):
-    """Plain causal attention. q: (batch, seq, heads, head_dim); k/v may
-    carry fewer (grouped-query) kv heads and are expanded here.
-    Ring/context-parallel execution swaps this for
+    """Local attention. q: (batch, seq, heads, head_dim); k/v may carry
+    fewer (grouped-query) kv heads and are expanded here. Long causal
+    sequences route to the blockwise O(s·chunk)-memory path (the dense
+    score tensor is gigabytes at seq 4096 and fails to compile on one
+    chip). Ring/context-parallel execution swaps this whole function for
     tpudist.ops.ring_attention at the shard_map level."""
+    if causal and q.shape[1] >= _BLOCKWISE_MIN_SEQ \
+            and q.shape[1] == k.shape[1] \
+            and q.shape[1] % _BLOCKWISE_CHUNK == 0:
+        from tpudist.ops.blockwise_attention import blockwise_causal_attention
+        return blockwise_causal_attention(q, k, v, chunk=_BLOCKWISE_CHUNK)
     if k.shape[2] != q.shape[2]:
         rep = q.shape[2] // k.shape[2]
         k = jnp.repeat(k, rep, axis=2)

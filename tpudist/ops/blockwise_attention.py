@@ -1,0 +1,76 @@
+"""Blockwise causal attention — the long-context local attention path.
+
+The plain einsum attention materialises the full (b, h, s, s) score tensor;
+at seq 4096 that is gigabytes and fails to compile on one chip. This is the
+standard blockwise/flash decomposition expressed in plain XLA ops: the
+query sequence is cut into chunks and each chunk folds key/value chunks
+through an online softmax — only lower-triangle (qi >= kj) blocks are
+computed, the diagonal gets the intra-chunk causal mask, and nothing bigger
+than a (b, h, chunk, chunk) block ever exists.
+
+Why not the stock pallas flash attention: measured on v5e at seq 4096 it
+runs 414 ms/fwd (tuned blocks; 289 ms default) vs 16.6 ms for this
+decomposition at chunk 1024 — XLA's own fusion of the einsum + online
+softmax is an order of magnitude better here, and this version needs no
+Mosaic path, so the CPU test lane runs it bit-identically.
+
+Used automatically by ``tpudist.models.transformer._attention`` for causal
+sequences >= 2048 (and by the context-parallel ring for long local shards).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG = -1e30
+
+
+def blockwise_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                               *, chunk: int = 1024) -> jax.Array:
+    """Causal attention, O(s·chunk) memory. q/k/v: (batch, seq, heads, hd);
+    k/v may carry fewer (grouped-query) heads. Returns (b, s, heads, hd) in
+    q's dtype. ``seq`` must divide by ``chunk`` (callers fall back to the
+    dense path otherwise)."""
+    b, s, hq, dq = q.shape
+    if s % chunk:
+        raise ValueError(f"seq {s} not divisible by chunk {chunk}")
+    if k.shape[2] != hq:
+        rep = hq // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    # (b, h, s, d) layout: chunk slices are contiguous in the matmul dims
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    scale = dq ** -0.5
+    nc = s // chunk
+    tri = (jnp.arange(chunk)[:, None] >= jnp.arange(chunk)[None, :])[None,
+                                                                    None]
+
+    def q_chunk_out(qi: int) -> jax.Array:
+        qc = qT[:, :, qi * chunk:(qi + 1) * chunk]
+        num = jnp.zeros((b, hq, chunk, dq), jnp.float32)
+        den = jnp.zeros((b, hq, chunk), jnp.float32)
+        mx = jnp.full((b, hq, chunk), NEG, jnp.float32)
+        for kj in range(qi + 1):             # lower triangle only
+            kc = kT[:, :, kj * chunk:(kj + 1) * chunk]
+            vc = vT[:, :, kj * chunk:(kj + 1) * chunk]
+            scores = jnp.einsum(
+                "bhqd,bhkd->bhqk", qc, kc,
+                preferred_element_type=jnp.float32) * scale
+            if kj == qi:                      # diagonal block: intra mask
+                scores = jnp.where(tri, scores, NEG)
+            bm = scores.max(-1)
+            nm = jnp.maximum(mx, bm)
+            corr = jnp.exp(mx - nm)
+            p = jnp.exp(scores - nm[..., None])
+            num = num * corr[..., None] + jnp.einsum(
+                "bhqk,bhkd->bhqd", p.astype(q.dtype), vc,
+                preferred_element_type=jnp.float32)
+            den = den * corr + p.sum(-1)
+            mx = nm
+        return (num / den[..., None]).astype(q.dtype)   # (b, h, chunk, d)
+
+    out = jnp.concatenate([q_chunk_out(i) for i in range(nc)], axis=2)
+    return out.transpose(0, 2, 1, 3)
