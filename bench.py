@@ -89,12 +89,18 @@ def main() -> None:
 
     n_dev = jax.device_count()
     seq = 512
-    # 24/chip: measured plateau on v5e for the plain path (81k tok/s/chip
-    # with unrolled layers + tuned scoped VMEM; 20→75.3k, 28→75.0k,
-    # 32→73.8k pre-tuning). The fused head removes the logits tensor from
-    # HBM so it runs big-batch; pairing it with remat keeps the backbone
-    # activations within HBM at batch 96.
-    per_chip = args.batch_per_chip or (96 if args.fused_xent else 24)
+    # 48/chip: measured plateau on v5e for the plain path with the pallas
+    # flash-attention kernel (24→83.9k, 32→86.0k, 48→87.1k, 64→83.5k
+    # tok/s/chip; without flash the score tensors OOM this batch). The
+    # fused head removes the logits tensor from HBM so it runs big-batch;
+    # pairing it with remat keeps the backbone activations within HBM at
+    # batch 96.
+    # with TPUDIST_NO_FLASH the dense score tensors cap the plain path at
+    # its old batch-24 plateau (48 OOMs)
+    import os
+    no_flash = bool(os.environ.get("TPUDIST_NO_FLASH"))
+    per_chip = args.batch_per_chip or (
+        96 if args.fused_xent else (24 if no_flash else 48))
     batch = per_chip * n_dev
     cfg = TrainConfig(
         batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",

@@ -1,0 +1,115 @@
+"""Pallas flash attention vs the dense reference (forward + gradients),
+run through the pallas interpreter on CPU. Shapes honor the kernel's TPU
+alignment floor (head_dim and seq multiples of 128) but stay small; block
+sizes of 128 force multi-block grids so the online softmax, causal block
+skipping, and both backward kernels' accumulators are all exercised."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist.ops.pallas import flash_attention as fa
+
+
+def _dense_ref(q, k, v, causal=True):
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    hd = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+def _data(b=1, s=256, h=2, kv=None, hd=128, seed=0, dtype=jnp.float32):
+    kv = kv or h
+    ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(ks[0], (b, s, h, hd), dtype)
+    k = jax.random.normal(ks[1], (b, s, kv, hd), dtype)
+    v = jax.random.normal(ks[2], (b, s, kv, hd), dtype)
+    return q, k, v
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_forward_matches_dense(causal):
+    q, k, v = _data()
+    got = fa.flash_attention(q, k, v, causal=causal, block_q=128,
+                             block_k=128, interpret=True)
+    want = _dense_ref(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_uneven_blocks():
+    # seq 384 with block 256 → falls back to 128-wide blocks via _pick_block
+    q, k, v = _data(s=384)
+    got = fa.flash_attention(q, k, v, causal=True, interpret=True)
+    want = _dense_ref(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_gradients_match_dense(causal):
+    q, k, v = _data()
+    ct = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+
+    def f_flash(q, k, v):
+        return jnp.vdot(fa.flash_attention(
+            q, k, v, causal=causal, block_q=128, block_k=128,
+            interpret=True), ct)
+
+    def f_dense(q, k, v):
+        return jnp.vdot(_dense_ref(q, k, v, causal=causal), ct)
+
+    got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(f_dense, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got, want, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_gqa_grouped_heads():
+    q, k, v = _data(h=4, kv=2)
+    got = fa.flash_attention(q, k, v, block_q=128, block_k=128,
+                             interpret=True)
+    want = _dense_ref(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+    # dk/dv must group-sum over the repeated query heads
+    ct = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    got_g = jax.grad(lambda a, b, c: jnp.vdot(fa.flash_attention(
+        a, b, c, block_q=128, block_k=128, interpret=True), ct),
+        argnums=(1, 2))(q, k, v)
+    want_g = jax.grad(lambda a, b, c: jnp.vdot(
+        _dense_ref(a, b, c), ct), argnums=(1, 2))(q, k, v)
+    for g, w in zip(got_g, want_g):
+        assert g.shape == (1, 256, 2, 128)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
+                                   rtol=1e-3)
+
+
+def test_bf16():
+    q, k, v = _data(dtype=jnp.bfloat16)
+    got = fa.flash_attention(q, k, v, block_q=128, block_k=128,
+                             interpret=True)
+    want = _dense_ref(q.astype(jnp.float32), k.astype(jnp.float32),
+                      v.astype(jnp.float32))
+    np.testing.assert_allclose(np.asarray(got, np.float32),
+                               np.asarray(want), atol=2e-2, rtol=2e-2)
+    assert got.dtype == jnp.bfloat16
+
+
+def test_supports_gates_shapes():
+    ok = ((1, 256, 2, 128), (1, 256, 2, 128))
+    assert fa.supports(*ok)
+    assert not fa.supports((1, 200, 2, 128), ok[1])      # seq not /128
+    assert not fa.supports((1, 256, 2, 64), ok[1])       # head_dim 64
+    assert not fa.supports((1, 256, 3, 128), ok[1])      # heads not /kv

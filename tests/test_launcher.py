@@ -138,18 +138,22 @@ def test_probe_mismatch_fails_before_training(stub_env):
         "training must not start on a bad slice"
 
 
-def test_provisioning_failure_and_timeout(stub_env):
+def test_provisioning_failure(stub_env):
     env, stub = stub_env
     env["STUB_STATE"] = "FAILED"
     r = launch(env)
     assert r.returncode == 1
     assert verdict(stub) == "fail"
 
-    env2, stub2 = stub_env
-    env2 = dict(env2, STUB_PENDING_POLLS="1000", TIMEOUT_S="0")
-    r = launch(env2)
+
+def test_provisioning_timeout(stub_env):
+    # separate test = fresh stub dir, so the fail verdict asserted here can
+    # only come from the timeout branch
+    env, stub = stub_env
+    env = dict(env, STUB_PENDING_POLLS="1000", TIMEOUT_S="0")
+    r = launch(env)
     assert r.returncode == 124
-    assert verdict(stub2) == "fail"
+    assert verdict(stub) == "fail"
 
 
 def test_sweep_gate_failure_exits_2_with_sweep_verdict(stub_env):

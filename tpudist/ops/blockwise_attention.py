@@ -15,13 +15,17 @@ softmax is an order of magnitude better here, and this version needs no
 Mosaic path, so the CPU test lane runs it bit-identically.
 
 Used automatically by ``tpudist.models.transformer._attention`` for causal
-sequences >= 2048 (and by the context-parallel ring for long local shards).
+sequences >= 2048 off-TPU (on TPU the pallas flash kernel takes those
+shapes; the context-parallel ring path has its own per-hop consume and
+does not call this).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from tpudist.ops.gqa import expand_gqa
 
 NEG = -1e30
 
@@ -35,10 +39,7 @@ def blockwise_causal_attention(q: jax.Array, k: jax.Array, v: jax.Array,
     b, s, hq, dq = q.shape
     if s % chunk:
         raise ValueError(f"seq {s} not divisible by chunk {chunk}")
-    if k.shape[2] != hq:
-        rep = hq // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
+    k, v = expand_gqa(q, k, v)
     # (b, h, s, d) layout: chunk slices are contiguous in the matmul dims
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)

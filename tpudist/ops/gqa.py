@@ -1,0 +1,28 @@
+"""Grouped-query attention head expansion, shared by every attention path.
+
+One definition (rather than a copy per kernel) so a future change — e.g.
+broadcast-reshape instead of ``jnp.repeat`` to keep expanded k/v out of
+HBM — lands everywhere at once.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def expand_gqa(q: jax.Array, k: jax.Array,
+               v: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Repeat grouped kv heads up to q's head count.
+
+    q: (..., heads, hd); k/v: (..., kv_heads, hd) with heads % kv_heads
+    == 0. Heads live on axis 2 in every caller's (batch, seq, heads, hd)
+    layout. Differentiable — the repeat's transpose group-sums dk/dv.
+    """
+    if k.shape[2] != q.shape[2]:
+        rep = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    return k, v
