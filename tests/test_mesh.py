@@ -8,10 +8,13 @@ from tpudist.parallel import build_mesh, resolve_axis_sizes
 
 
 def test_resolve_infers_data_axis():
-    assert resolve_axis_sizes(ParallelConfig(), 8) == (8, 1, 1, 1)
-    assert resolve_axis_sizes(ParallelConfig(fsdp=4), 8) == (2, 4, 1, 1)
+    assert resolve_axis_sizes(ParallelConfig(), 8) == (8, 1, 1, 1, 1, 1)
+    assert resolve_axis_sizes(ParallelConfig(fsdp=4), 8) \
+        == (2, 1, 4, 1, 1, 1)
     assert resolve_axis_sizes(ParallelConfig(fsdp=2, tensor=2), 8) \
-        == (2, 2, 2, 1)
+        == (2, 1, 2, 1, 2, 1)
+    assert resolve_axis_sizes(ParallelConfig(pipe=2, expert=2), 8) \
+        == (2, 2, 1, 2, 1, 1)
 
 
 def test_resolve_rejects_bad_factorisation():
@@ -23,5 +26,6 @@ def test_resolve_rejects_bad_factorisation():
 
 def test_build_mesh_axes(devices8):
     mesh = build_mesh(ParallelConfig(fsdp=2), devices=devices8)
-    assert mesh.axis_names == ("data", "fsdp", "tensor", "context")
-    assert mesh.devices.shape == (4, 2, 1, 1)
+    assert mesh.axis_names == ("data", "pipe", "fsdp", "expert", "tensor",
+                               "context")
+    assert mesh.devices.shape == (4, 1, 2, 1, 1, 1)

@@ -40,7 +40,7 @@ def test_tp_params_are_sharded(devices8):
     mesh = build_mesh(cfg.parallel, devices=devices8)
     state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
     wq = state.params["layers"]["wq"]
-    assert wq.sharding.spec == P(None, "fsdp", "tensor")
+    assert wq.sharding.spec == P("pipe", "fsdp", "tensor")
     # column-parallel: output dim split 4 ways
     assert wq.sharding.shard_shape(wq.shape)[-1] == wq.shape[-1] // 4
 

@@ -43,6 +43,13 @@ class ModelConfig:
     d_ff: int = 5504
     max_seq_len: int = 2048
     rope_theta: float = 10000.0
+    # moe-only fields (model name "moe": transformer blocks with a
+    # mixture-of-experts FFN, experts sharded over the mesh's expert axis)
+    n_experts: int = 8
+    expert_top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.01
+    moe_group_size: int = 4096    # routing group (bounds dispatch memory)
 
 
 @dataclass(frozen=True)
@@ -52,7 +59,9 @@ class ParallelConfig:
     shardings are uniform across configurations)."""
 
     data: int = -1
+    pipe: int = 1
     fsdp: int = 1
+    expert: int = 1
     tensor: int = 1
     context: int = 1
 
@@ -73,6 +82,7 @@ class TrainConfig:
     remat: bool = False           # checkpoint transformer layers
     xent_chunks: int = 0          # stream LM head+loss over N seq chunks
     fused_xent: bool = False      # pallas fused LM head+loss (no HBM logits)
+    pp_microbatches: int = 0      # pipeline microbatches (0 = pipe size)
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
@@ -104,7 +114,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --save-dir")
     p.add_argument("--model", type=str, default="mlp",
-                   choices=["mlp", "transformer"])
+                   choices=["mlp", "transformer", "moe"])
     p.add_argument("--dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--grad-accum-steps", type=int, default=1)
@@ -130,6 +140,17 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--fsdp", type=int, default=1, help="fsdp mesh axis size")
     p.add_argument("--tensor", type=int, default=1, help="tensor mesh axis size")
     p.add_argument("--context", type=int, default=1, help="context mesh axis size")
+    p.add_argument("--pipe", type=int, default=1,
+                   help="pipeline mesh axis size (GPipe schedule over "
+                        "transformer layer stages)")
+    p.add_argument("--expert", type=int, default=1,
+                   help="expert mesh axis size (MoE expert parallelism)")
+    p.add_argument("--pp-microbatches", type=int, default=0,
+                   help="pipeline microbatches per step (0 = pipe size)")
+    # moe shape
+    p.add_argument("--n-experts", type=int, default=8)
+    p.add_argument("--expert-top-k", type=int, default=2)
+    p.add_argument("--capacity-factor", type=float, default=1.25)
     p.add_argument("--fail-at", type=int, default=None,
                    help="fault injection: fail after this epoch (replaces the "
                         "reference's commented-out sys.exit(1), train.py:129)")
@@ -152,6 +173,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         remat=args.remat,
         xent_chunks=args.xent_chunks,
         fused_xent=args.fused_xent,
+        pp_microbatches=args.pp_microbatches,
         fail_at=args.fail_at,
         log_every=args.log_every,
         profile_dir=args.profile_dir,
@@ -162,7 +184,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                           d_model=args.d_model, n_heads=args.n_heads,
                           n_kv_heads=(args.n_kv_heads if args.n_kv_heads
                                       is not None else args.n_heads),
-                          d_ff=args.d_ff, max_seq_len=args.seq_len),
-        parallel=ParallelConfig(fsdp=args.fsdp, tensor=args.tensor,
+                          d_ff=args.d_ff, max_seq_len=args.seq_len,
+                          n_experts=args.n_experts,
+                          expert_top_k=args.expert_top_k,
+                          capacity_factor=args.capacity_factor),
+        parallel=ParallelConfig(pipe=args.pipe, fsdp=args.fsdp,
+                                expert=args.expert, tensor=args.tensor,
                                 context=args.context),
     )

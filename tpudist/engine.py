@@ -63,10 +63,40 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
     model = get_model(cfg.model.name)
     dt = _compute_dtype(cfg)
     if cfg.model.name == "mlp":
+        if mesh is not None and mesh.shape.get("pipe", 1) > 1:
+            raise ValueError("pipeline parallelism requires a layered "
+                             "model (transformer/moe), not mlp")
         return functools.partial(model.loss_fn, dtype=dt)
 
+    pp = mesh is not None and mesh.shape.get("pipe", 1) > 1
     cp = mesh is not None and mesh.shape.get("context", 1) > 1
+    if pp:
+        if cp:
+            raise ValueError(
+                "pipe and context parallelism both manualize their own "
+                "mesh axis in a shard_map and do not compose; pick one")
+        if cfg.fused_xent or cfg.xent_chunks:
+            raise ValueError(
+                "the pipeline path computes the plain whole-logits head "
+                "per microbatch; --fused-xent/--xent-chunks do not apply")
+        if cfg.model.name != "transformer":
+            raise ValueError(
+                "pipeline parallelism currently supports the dense "
+                "transformer (the pp slot body runs transformer layers)")
+        from tpudist.parallel.pipeline import make_pp_loss_fn
+        pp_loss = make_pp_loss_fn(cfg.model, mesh,
+                                  n_microbatches=cfg.pp_microbatches,
+                                  dtype=dt, remat=cfg.remat)
+
+        def loss(params, batch):
+            tokens = batch[0] if isinstance(batch, tuple) else batch
+            return pp_loss(params, tokens)
+        return loss
     if cp:
+        if not hasattr(model, "make_cp_loss_fn"):
+            raise ValueError(
+                f"context parallelism is not implemented for model "
+                f"{cfg.model.name!r}")
         cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt,
                                         remat=cfg.remat,
                                         xent_chunks=cfg.xent_chunks,
@@ -187,7 +217,8 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     """
     tx = make_optimizer(cfg)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    pure_dp = all(axis_sizes[a] == 1 for a in ("fsdp", "tensor", "context"))
+    pure_dp = all(axis_sizes.get(a, 1) == 1
+                  for a in ("pipe", "fsdp", "expert", "tensor", "context"))
     # the logits constraint belongs to the jit+shardings path only — inside
     # the shard_map DP body every mesh axis is manual and a NamedSharding
     # constraint is rejected at trace time

@@ -8,9 +8,9 @@ plus a ``param_specs(cfg, axes)`` function mapping the params pytree to
 classes — pytrees compose directly with ``jit``/``shard_map``/optax.
 """
 
-from tpudist.models import mlp, transformer
+from tpudist.models import mlp, moe, transformer
 
-_REGISTRY = {"mlp": mlp, "transformer": transformer}
+_REGISTRY = {"mlp": mlp, "transformer": transformer, "moe": moe}
 
 
 def get_model(name: str):

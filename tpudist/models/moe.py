@@ -1,0 +1,227 @@
+"""Mixture-of-experts transformer with expert parallelism, TPU-native.
+
+The reference has no MoE (or any model beyond a 20-feature MLP, reference
+``train.py:26-36``); this is a north-star model family exercising the one
+collective pattern the dense models don't: the all-to-all token shuffle of
+expert parallelism.
+
+Built the GShard/Switch way rather than the torch way: routing is dense
+einsum dispatch — a (tokens, experts, capacity) one-hot dispatch tensor
+contracted against token activations — instead of data-dependent
+gather/scatter. Everything stays statically shaped (XLA requirement:
+capacity bounds the per-expert token count; overflow tokens fall through
+the residual), and expert sharding is just a PartitionSpec on the experts
+dim of the FFN weights: contracting a token-sharded dispatch tensor
+against expert-sharded weights makes the SPMD partitioner emit the
+all-to-alls — no hand-written collectives (the scaling-book recipe).
+
+Layers: pre-norm attention identical to the dense transformer (shared
+``_attn_sublayer``); the FFN half is top-k routed SwiGLU experts plus the
+Switch load-balancing auxiliary loss (aux = E·Σ_e f_e·P_e, added to the
+objective with ``router_aux_weight``).
+
+Routing semantics: routing, capacity, and the aux loss are computed over
+the batch the loss function sees. Under the engine's jit+shardings path
+that is the GLOBAL batch; under the explicit shard_map DP path it is the
+per-shard batch (group-local routing, the usual MoE deployment choice —
+it keeps dispatch inside the DP shard). Capacity-constrained token-choice
+routing is not batch-partition-invariant, so the two paths differ in
+exact loss value for this model — unlike the dense models, where the
+engine's two paths agree bitwise. Each path is individually deterministic.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from tpudist.config import ModelConfig
+from tpudist.models import transformer as T
+
+Params = Dict
+
+
+def capacity(cfg: ModelConfig, n_tokens: int) -> int:
+    """Static per-expert token budget: cf · (routed pairs) / E, floored at
+    one row and rounded up to keep every assignment at cf >= 1 exactly."""
+    pairs = n_tokens * cfg.expert_top_k
+    return max(1, -(-int(pairs * cfg.capacity_factor) // cfg.n_experts))
+
+
+def group_size(cfg: ModelConfig, n_tokens: int) -> int:
+    """Tokens per routing group. Routing within fixed-size groups (the
+    GShard recipe) keeps the (group, E, cap) dispatch tensors LINEAR in
+    total tokens — one global group would make them quadratic, since
+    capacity itself scales with the routed token count. Token counts that
+    ``moe_group_size`` doesn't divide fall back to one global group
+    (fine at test scale, which is when that happens)."""
+    g = cfg.moe_group_size
+    return g if 0 < g < n_tokens and n_tokens % g == 0 else n_tokens
+
+
+def init(key: jax.Array, cfg: ModelConfig) -> Params:
+    d, h, kv, L = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    E, dff = cfg.n_experts, cfg.d_ff
+    hd = d // h
+    keys = jax.random.split(key, 10)
+
+    def w(key, *shape, fan_in):
+        return (jax.random.normal(key, shape, jnp.float32)
+                * (1.0 / jnp.sqrt(fan_in)))
+
+    return {
+        "embed": w(keys[0], cfg.vocab_size, d, fan_in=d),
+        "layers": {
+            "attn_norm": jnp.ones((L, d), jnp.float32),
+            "wq": w(keys[1], L, d, h * hd, fan_in=d),
+            "wk": w(keys[2], L, d, kv * hd, fan_in=d),
+            "wv": w(keys[3], L, d, kv * hd, fan_in=d),
+            "wo": w(keys[4], L, h * hd, d, fan_in=h * hd),
+            "ffn_norm": jnp.ones((L, d), jnp.float32),
+            "w_router": w(keys[5], L, d, E, fan_in=d),
+            "w_gate": w(keys[6], L, E, d, dff, fan_in=d),
+            "w_up": w(keys[7], L, E, d, dff, fan_in=d),
+            "w_down": w(keys[8], L, E, dff, d, fan_in=dff),
+        },
+        "final_norm": jnp.ones((d,), jnp.float32),
+    }
+
+
+def _route(probs: jax.Array, k: int, cap: int
+           ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k token-choice routing with capacity, for one routing group.
+
+    probs: (t, E) f32 router softmax. Returns (dispatch, combine,
+    assigned): dispatch (t, E, cap) is the 0/1 token→slot assignment,
+    combine is dispatch scaled by the token's renormalised gate, and
+    assigned (E,) counts PRE-capacity-drop assignments per expert — the
+    aux loss must use these, or the balancing penalty saturates exactly
+    when experts overflow. Slot positions are assigned in (token, k-slot)
+    priority order; pairs past an expert's capacity are dropped (their FFN
+    contribution is zero — the residual carries the token).
+    """
+    t, E = probs.shape
+    gates, idx = lax.top_k(probs, k)                     # (t, k)
+    gates = gates / jnp.maximum(gates.sum(-1, keepdims=True), 1e-9)
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)   # (t, k, E)
+    flat = onehot.reshape(t * k, E)                      # priority order
+    pos = (jnp.cumsum(flat, axis=0) - flat)              # slot within expert
+    pos = (pos * flat).sum(-1).reshape(t, k).astype(jnp.int32)   # (t, k)
+    kept = onehot * (pos < cap)[..., None]               # (t, k, E)
+    slot = jax.nn.one_hot(pos, cap, dtype=jnp.float32)   # (t, k, cap)
+    dispatch = jnp.einsum("tke,tkc->tec", kept, slot)
+    combine = jnp.einsum("tke,tkc,tk->tec", kept, slot, gates)
+    return dispatch, combine, onehot.sum(axis=(0, 1))
+
+
+def _moe_ffn(y: jax.Array, lp, cfg: ModelConfig
+             ) -> Tuple[jax.Array, jax.Array]:
+    """Routed SwiGLU experts. y: (b, s, d) normed activations. Returns
+    (ffn_out (b, s, d), aux scalar). Routing is group-local (see
+    ``group_size``); groups split along the token-major order, so they
+    align with the batch sharding and dispatch stays shard-local until
+    the expert contraction."""
+    b, s, d = y.shape
+    dt = y.dtype
+    t = b * s
+    g = group_size(cfg, t)
+    cap = capacity(cfg, g)
+    yg = y.reshape(t // g, g, d)                         # (G, g, d)
+
+    logits = jnp.einsum("gtd,de->gte", yg,
+                        lp["w_router"].astype(dt)).astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)              # (G, g, E)
+    dispatch, combine, assigned = jax.vmap(
+        lambda p: _route(p, cfg.expert_top_k, cap))(probs)
+
+    # token-sharded groups against expert-sharded weights → the SPMD
+    # partitioner inserts the all-to-alls here
+    xe = jnp.einsum("gtd,gtec->gecd", yg, dispatch.astype(dt))
+    gate = jax.nn.silu(jnp.einsum("gecd,edf->gecf", xe,
+                                  lp["w_gate"].astype(dt)))
+    up = jnp.einsum("gecd,edf->gecf", xe, lp["w_up"].astype(dt))
+    ye = jnp.einsum("gecf,efd->gecd", gate * up, lp["w_down"].astype(dt))
+    out = jnp.einsum("gecd,gtec->gtd", ye, combine.astype(dt))
+
+    # Switch aux: fraction of routed pairs per expert (hard counts, pre-
+    # drop) × mean router probability, scaled by E — minimised by uniform
+    # routing, and still informative when experts overflow
+    f_e = assigned.sum(0) / (t * cfg.expert_top_k)
+    p_e = probs.mean(axis=(0, 1))
+    aux = cfg.n_experts * jnp.sum(f_e * p_e)
+    return out.reshape(b, s, d), aux
+
+
+def _moe_layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
+    x = T._attn_sublayer(x, lp, cfg, cos, sin, attn_impl)
+    y = T.rmsnorm(x, lp["ffn_norm"])
+    ffn, aux = _moe_ffn(y, lp, cfg)
+    return x + ffn, aux
+
+
+def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+                  dtype=jnp.bfloat16, attn_impl=T._attention,
+                  remat: bool = False) -> Tuple[jax.Array, jax.Array]:
+    """Backbone forward → (final-norm hidden states, mean aux loss)."""
+    s = tokens.shape[1]
+    hd = cfg.d_model // cfg.n_heads
+    cos, sin = T.precompute_rope(s, hd, cfg.rope_theta)
+    x = params["embed"].astype(dtype)[tokens]
+
+    def body(carry, lp):
+        x, aux = carry
+        x, a = _moe_layer(x, lp, cfg, cos, sin, attn_impl)
+        return (x, aux + a), None
+
+    if remat:
+        body = jax.checkpoint(body)
+    (x, aux), _ = lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                           params["layers"], unroll=cfg.n_layers <= 8)
+    return T.rmsnorm(x, params["final_norm"]), aux / cfg.n_layers
+
+
+def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
+                tensor_axis: str = "tensor", pipe_axis: str = "pipe",
+                expert_axis: str = "expert") -> Params:
+    """Dense-transformer sharding for the shared half; expert FFN weights
+    shard their experts dim over ``expert`` (the EP axis), then d_model
+    over fsdp and the expert-hidden dim over tensor."""
+    f, t, pp, e = fsdp_axis, tensor_axis, pipe_axis, expert_axis
+    return {
+        "embed": P(f, None),
+        "layers": {
+            "attn_norm": P(pp, None),
+            "wq": P(pp, f, t),
+            "wk": P(pp, f, t),
+            "wv": P(pp, f, t),
+            "wo": P(pp, t, f),
+            "ffn_norm": P(pp, None),
+            "w_router": P(pp, f, None),
+            "w_gate": P(pp, e, f, t),
+            "w_up": P(pp, e, f, t),
+            "w_down": P(pp, e, t, f),
+        },
+        "final_norm": P(None),
+    }
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+            dtype=jnp.bfloat16, remat: bool = False,
+            xent_chunks: int = 0, fused_xent: bool = False,
+            logits_sharding=None) -> jax.Array:
+    """Causal next-token cross-entropy + router load-balancing aux.
+
+    The LM-head strategies are the dense transformer's
+    (:func:`transformer.head_loss`): whole-logits, ``xent_chunks``
+    streaming, or the pallas fused kernel.
+    """
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    h, aux = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
+    xent = T.head_loss(params["embed"].astype(dtype), h, targets,
+                       xent_chunks=xent_chunks, fused_xent=fused_xent,
+                       logits_sharding=logits_sharding)
+    return xent + cfg.router_aux_weight * aux

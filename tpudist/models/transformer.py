@@ -150,8 +150,9 @@ def _attention(q, k, v, *, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
-    """One decoder layer. x: (batch, seq, d_model)."""
+def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
+    """Pre-norm attention + residual. Shared with the MoE model, whose
+    layers differ only in the FFN half."""
     b, s, d = x.shape
     h, kv = cfg.n_heads, cfg.n_kv_heads
     hd = d // h
@@ -166,8 +167,13 @@ def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     # GQA: compact kv heads go to the attention impl as-is — ring attention
     # must transfer the small blocks; expansion happens inside the kernel.
     o = attn_impl(q, k, v).reshape(b, s, h * hd)
-    x = x + o @ lp["wo"].astype(dt)
+    return x + o @ lp["wo"].astype(dt)
 
+
+def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
+    """One decoder layer. x: (batch, seq, d_model)."""
+    dt = x.dtype
+    x = _attn_sublayer(x, lp, cfg, cos, sin, attn_impl)
     y = rmsnorm(x, lp["ffn_norm"])
     gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
     up = y @ lp["w_up"].astype(dt)
@@ -219,7 +225,8 @@ def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
 
 
 def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
-                tensor_axis: str = "tensor") -> Params:
+                tensor_axis: str = "tensor",
+                pipe_axis: str = "pipe") -> Params:
     """Megatron-style tensor sharding + FSDP on the other dim.
 
     Column-parallel (shard output dim on tensor): wq/wk/wv/w_gate/w_up.
@@ -227,21 +234,24 @@ def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
     Embedding: vocab dim on fsdp only — sharding its model dim on tensor
     trips an XLA SPMD-partitioner CHECK crash on the token-gather (observed
     on the CPU backend, jax 0.9); the layer weights carry the TP work.
-    Leading layer dim of stacked weights is never sharded.
+    Leading layer dim of stacked weights is sharded over the pipeline axis
+    (each stage owns its contiguous layer slice; a size-1 pipe axis makes
+    this a no-op, and sanitize_specs drops it when n_layers doesn't
+    divide).
     """
-    f, t = fsdp_axis, tensor_axis
+    f, t, pp = fsdp_axis, tensor_axis, pipe_axis
     return {
         "embed": P(f, None),
         "layers": {
-            "attn_norm": P(None, None),
-            "wq": P(None, f, t),
-            "wk": P(None, f, t),
-            "wv": P(None, f, t),
-            "wo": P(None, t, f),
-            "ffn_norm": P(None, None),
-            "w_gate": P(None, f, t),
-            "w_up": P(None, f, t),
-            "w_down": P(None, t, f),
+            "attn_norm": P(pp, None),
+            "wq": P(pp, f, t),
+            "wk": P(pp, f, t),
+            "wv": P(pp, f, t),
+            "wo": P(pp, t, f),
+            "ffn_norm": P(pp, None),
+            "w_gate": P(pp, f, t),
+            "w_up": P(pp, f, t),
+            "w_down": P(pp, t, f),
         },
         "final_norm": P(None),
     }
@@ -288,16 +298,16 @@ def _fused_head_xent(embed: jax.Array, h: jax.Array,
                               targets.reshape(b * s), interpret=interpret)
 
 
-def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
-            dtype=jnp.bfloat16, remat: bool = False,
-            xent_chunks: int = 0, fused_xent: bool = False,
-            logits_sharding=None) -> jax.Array:
-    """Causal next-token cross-entropy over the synthetic token stream.
+def head_loss(emb: jax.Array, h: jax.Array, targets: jax.Array, *,
+              xent_chunks: int = 0, fused_xent: bool = False,
+              logits_sharding=None) -> jax.Array:
+    """Tied LM head + mean cross-entropy — the ONE head-strategy dispatch,
+    shared by the dense, context-parallel, and MoE loss paths.
 
-    ``fused_xent`` routes the LM head + loss through the pallas kernel
-    (no logits in HBM); ``xent_chunks`` > 0 streams the head over that many
-    sequence chunks with jnp + checkpoint (memory-bound win at large
-    batch×seq×vocab); 0/off keeps the simple whole-logits path.
+    ``fused_xent`` routes through the pallas kernel (no logits in HBM);
+    ``xent_chunks`` > 0 streams the head over that many sequence chunks
+    with jnp + checkpoint (memory-bound win at large batch×seq×vocab);
+    0/off keeps the simple whole-logits path.
 
     ``logits_sharding`` (a NamedSharding) pins the (b, s, vocab) logits —
     and, through the constraint's transpose, their cotangent — to the batch
@@ -308,10 +318,8 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
     if fused_xent and xent_chunks:
         raise ValueError("--fused-xent and --xent-chunks are mutually "
                          "exclusive LM-head strategies")
-    inputs, targets = tokens[:, :-1], tokens[:, 1:]
     if fused_xent:
-        h = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
-        return _fused_head_xent(params["embed"].astype(dtype), h, targets)
+        return _fused_head_xent(emb, h, targets)
     if xent_chunks:
         if targets.shape[1] % xent_chunks:
             # erroring beats silently materialising the full logits tensor
@@ -319,13 +327,24 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
             raise ValueError(
                 f"sequence length {targets.shape[1]} not divisible by "
                 f"xent_chunks={xent_chunks}")
-        h = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
-        return _chunked_head_xent(params["embed"].astype(dtype), h, targets,
-                                  xent_chunks)
-    logits = apply(params, inputs, cfg, dtype=dtype, remat=remat)
+        return _chunked_head_xent(emb, h, targets, xent_chunks)
+    logits = (h @ emb.T).astype(jnp.float32)
     if logits_sharding is not None:
         logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
     return _xent(logits, targets)
+
+
+def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
+            dtype=jnp.bfloat16, remat: bool = False,
+            xent_chunks: int = 0, fused_xent: bool = False,
+            logits_sharding=None) -> jax.Array:
+    """Causal next-token cross-entropy over the synthetic token stream.
+    Head strategy selection: see :func:`head_loss`."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    h = hidden_states(params, inputs, cfg, dtype=dtype, remat=remat)
+    return head_loss(params["embed"].astype(dtype), h, targets,
+                     xent_chunks=xent_chunks, fused_xent=fused_xent,
+                     logits_sharding=logits_sharding)
 
 
 def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
@@ -364,27 +383,12 @@ def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
                 return ring_attention_local(q, k, v, axis, causal=True,
                                             layout="zigzag")
 
-            if fused_xent:
-                h = hidden_states(params, inputs, cfg, dtype=dtype,
-                                  attn_impl=attn, rope_positions=pos,
-                                  remat=remat)
-                local = _fused_head_xent(params["embed"].astype(dtype), h,
-                                         targets)
-            elif xent_chunks:
-                if s_local % xent_chunks:
-                    raise ValueError(
-                        f"local sequence {s_local} not divisible by "
-                        f"xent_chunks={xent_chunks}")
-                h = hidden_states(params, inputs, cfg, dtype=dtype,
-                                  attn_impl=attn, rope_positions=pos,
-                                  remat=remat)
-                local = _chunked_head_xent(params["embed"].astype(dtype), h,
-                                           targets, xent_chunks)
-            else:
-                logits = apply(params, inputs, cfg, dtype=dtype,
-                               attn_impl=attn, rope_positions=pos,
-                               remat=remat)
-                local = _xent(logits, targets)
+            h = hidden_states(params, inputs, cfg, dtype=dtype,
+                              attn_impl=attn, rope_positions=pos,
+                              remat=remat)
+            local = head_loss(params["embed"].astype(dtype), h, targets,
+                              xent_chunks=xent_chunks,
+                              fused_xent=fused_xent)
             return lax.pmean(local, axis)
 
         return jax.shard_map(

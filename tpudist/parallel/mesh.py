@@ -2,15 +2,17 @@
 
 The reference had no mesh concept — its only topology was "one process per
 GPU, NCCL flat world" (reference ``slurm_train.sbatch:18-23``). TPU-first,
-the mesh IS the parallelism config: a 4-axis ``jax.sharding.Mesh`` over
-``('data', 'fsdp', 'tensor', 'context')``. Axes of size 1 cost nothing, so
-every workload uses the same mesh shape and the same PartitionSpecs — DP-only
-is just ``(n, 1, 1, 1)``.
+the mesh IS the parallelism config: a 6-axis ``jax.sharding.Mesh`` over
+``('data', 'pipe', 'fsdp', 'expert', 'tensor', 'context')``. Axes of size 1
+cost nothing, so every workload uses the same mesh shape and the same
+PartitionSpecs — DP-only is just ``(n, 1, 1, 1, 1, 1)``.
 
 Axis layout order matters on hardware: ``jax.make_mesh`` assigns the
-fastest-varying (last) axes to the most tightly coupled devices, so we order
-axes (data, fsdp, tensor, context) → tensor/context land on intra-host ICI
-neighbours, data crosses DCN first — collectives ride ICI wherever possible.
+fastest-varying (last) axes to the most tightly coupled devices, so axes are
+ordered by communication intensity — tensor/context (per-layer collectives)
+land on intra-host ICI neighbours, expert all-to-alls next, then fsdp
+weight gathers; pipe (latency-tolerant point-to-point activations) and data
+(one gradient all-reduce per step) cross DCN first.
 """
 
 from __future__ import annotations
@@ -24,38 +26,41 @@ from jax.sharding import Mesh
 from tpudist.config import ParallelConfig
 
 # canonical axis order, most-global first
-AXIS_NAMES: Tuple[str, ...] = ("data", "fsdp", "tensor", "context")
+AXIS_NAMES: Tuple[str, ...] = ("data", "pipe", "fsdp", "expert", "tensor",
+                               "context")
 
 
 @dataclass(frozen=True)
 class MeshAxes:
     data: str = "data"
+    pipe: str = "pipe"
     fsdp: str = "fsdp"
+    expert: str = "expert"
     tensor: str = "tensor"
     context: str = "context"
 
 
-def resolve_axis_sizes(cfg: ParallelConfig,
-                       n_devices: int) -> Tuple[int, int, int, int]:
+def resolve_axis_sizes(cfg: ParallelConfig, n_devices: int
+                       ) -> Tuple[int, int, int, int, int, int]:
     """Resolve ``data=-1`` to "all remaining devices" and validate the
     factorisation (the topology-probe analogue of the reference CI's
     ``scontrol`` probe + sed patch, ci:115-119 — shapes are derived from the
     live device count, never hard-coded)."""
-    fixed = cfg.fsdp * cfg.tensor * cfg.context
+    fixed = cfg.pipe * cfg.fsdp * cfg.expert * cfg.tensor * cfg.context
     if fixed <= 0:
         raise ValueError(f"axis sizes must be >=1, got {cfg}")
     data = cfg.data
     if data == -1:
         if n_devices % fixed:
             raise ValueError(
-                f"{n_devices} devices not divisible by fsdp*tensor*context="
-                f"{fixed}")
+                f"{n_devices} devices not divisible by pipe*fsdp*expert*"
+                f"tensor*context={fixed}")
         data = n_devices // fixed
     if data * fixed != n_devices:
         raise ValueError(
-            f"mesh {data}x{cfg.fsdp}x{cfg.tensor}x{cfg.context} != "
-            f"{n_devices} devices")
-    return (data, cfg.fsdp, cfg.tensor, cfg.context)
+            f"mesh {data}x{cfg.pipe}x{cfg.fsdp}x{cfg.expert}x{cfg.tensor}"
+            f"x{cfg.context} != {n_devices} devices")
+    return (data, cfg.pipe, cfg.fsdp, cfg.expert, cfg.tensor, cfg.context)
 
 
 def build_mesh(cfg: Optional[ParallelConfig] = None,

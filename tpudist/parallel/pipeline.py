@@ -1,0 +1,140 @@
+"""Pipeline parallelism: a GPipe schedule as one SPMD program.
+
+The reference has no pipeline concept (its model is a 2-layer MLP on a flat
+NCCL world, reference ``train.py:26-36``); this is a north-star extension,
+built the TPU way: instead of per-stage processes exchanging tensors
+(torch-style p2p send/recv), the whole pipeline is ONE jitted SPMD program
+over the mesh's ``pipe`` axis —
+
+  * the stacked layer params' leading dim is sharded over ``pipe``
+    (``transformer.param_specs``), so each device holds a contiguous slice
+    of layers: its stage;
+  * a ``lax.scan`` over ``M + S - 1`` slots rotates microbatch activations
+    around the stage ring with ``lax.ppermute``; stage 0 ingests a fresh
+    microbatch per slot, the last stage completes one per slot after the
+    fill;
+  * the backward pipeline comes from the transposes JAX already has: the
+    scan reverses and every ppermute becomes its inverse permute — no
+    hand-written 1F1B machinery, and gradient accumulation over
+    microbatches falls out of the scan for free.
+
+SPMD lockstep means every stage executes the identical slot program —
+ingest (embedding gather), its layers, and the LM head — with the ingest
+and the loss masked off except at the ring's ends. The head matmul per
+slot is the price of the single-program design (~head/(layers/S) relative
+overhead); the layers dominate at depth, which is when PP is used at all.
+
+Composes with data/fsdp/tensor sharding: only ``pipe`` is manualized in
+the shard_map; batch and weight dims keep flowing through the SPMD
+partitioner. Context parallelism does not compose (ring attention manual-
+izes ``context`` in its own shard_map) — the engine rejects that pairing.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from tpudist.config import ModelConfig
+
+
+def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
+                    n_microbatches: int = 0, axis: str = "pipe",
+                    dtype=jnp.bfloat16, remat: bool = False) -> Callable:
+    """(params, tokens) -> scalar loss, pipelined over ``axis``.
+
+    ``tokens``: (batch, seq+1) int32, replicated over ``axis`` (batch dims
+    ride data/fsdp outside the manual region). ``n_microbatches`` 0 means
+    one microbatch per stage — the minimum that fills the pipeline.
+    """
+    from tpudist.models import transformer as T
+
+    n_stages = mesh.shape[axis]
+    n_micro = n_microbatches or n_stages
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}")
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def loss(params: dict, tokens: jax.Array) -> jax.Array:
+        if tokens.shape[0] % n_micro:
+            raise ValueError(
+                f"per-shard batch {tokens.shape[0]} not divisible by "
+                f"pp_microbatches={n_micro}")
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        # Gather fsdp/tensor weight shards OUTSIDE the manual region (the
+        # SPMD partitioner CHECK-crashes expanding fsdp device groups
+        # inside a partially-manual shard_map — spmd_partitioner_util.cc
+        # ExpandDeviceGroupsWithIota, observed jax 0.9 CPU). ZeRO-style:
+        # fsdp shards the STORAGE of params/grads/opt-state; compute sees
+        # gathered weights, and this constraint's transpose reduce-
+        # scatters the grads back to their shards.
+        ns = lambda spec: jax.sharding.NamedSharding(mesh, spec)
+        params = {
+            "embed": jax.lax.with_sharding_constraint(
+                params["embed"], ns(P())),
+            "layers": jax.tree.map(
+                lambda x: jax.lax.with_sharding_constraint(
+                    x, ns(P(axis))), params["layers"]),
+            "final_norm": params["final_norm"],
+        }
+        # embedding lookup also hoisted: one gather instead of per-slot
+        x_emb = params["embed"].astype(dtype)[inputs]     # (b, s, d)
+
+        def body(params, x_emb, targets):
+            stage = lax.axis_index(axis)
+            b, s, _ = x_emb.shape
+            mb_x = x_emb.reshape(n_micro, b // n_micro, s, cfg.d_model)
+            mb_tgt = targets.reshape(n_micro, b // n_micro, s)
+            hd = cfg.d_model // cfg.n_heads
+            cos, sin = T.precompute_rope(s, hd, cfg.rope_theta)
+            emb = params["embed"].astype(dtype)
+            layers_local = params["layers"]     # leading dim n_layers/S
+
+            def run_stage(x):
+                def lbody(x, lp):
+                    return T._layer(x, lp, cfg, cos, sin,
+                                    T._attention), None
+                if remat:
+                    lbody = jax.checkpoint(lbody)
+                x, _ = lax.scan(lbody, x, layers_local,
+                                unroll=cfg.n_layers // n_stages <= 8)
+                return x
+
+            def slot(carry, t):
+                x, loss_sum = carry
+                # ring ends, masked elsewhere: stage 0 ingests microbatch
+                # t; the last stage completes microbatch t-(S-1)
+                ingest = mb_x[jnp.clip(t, 0, n_micro - 1)]
+                x = jnp.where(stage == 0, ingest, x)
+                x = run_stage(x)
+                done = t - (n_stages - 1)
+                mb_l = T.head_loss(emb, T.rmsnorm(x, params["final_norm"]),
+                                   mb_tgt[jnp.clip(done, 0, n_micro - 1)])
+                valid = (stage == n_stages - 1) & (done >= 0)
+                loss_sum = loss_sum + jnp.where(valid, mb_l, 0.0)
+                x = lax.ppermute(x, axis, perm)
+                return (x, loss_sum), None
+
+            x0 = jnp.zeros((b // n_micro, s, cfg.d_model), dtype)
+            (_, loss_sum), _ = lax.scan(
+                slot, (x0, jnp.zeros((), jnp.float32)),
+                jnp.arange(n_micro + n_stages - 1))
+            # only the last stage accumulated; psum replicates the scalar
+            return lax.psum(loss_sum, axis) / n_micro
+
+        # prefix specs: every stacked layer leaf is stage-sharded on its
+        # leading dim; embed/final_norm are replicated over pipe (the tied
+        # table is consumed at both ring ends)
+        pspecs = {"embed": P(), "layers": P(axis), "final_norm": P()}
+        return jax.shard_map(body, mesh=mesh,
+                             in_specs=(pspecs, P(), P()),
+                             out_specs=P(), axis_names=frozenset({axis}),
+                             check_vma=False)(params, x_emb, targets)
+
+    return loss
