@@ -55,3 +55,38 @@ def test_cp_combined_with_dp(devices8):
     mesh = build_mesh(cfg.parallel, devices=devices8)
     _, losses = _run(cfg, mesh)
     assert losses[-1] < losses[0]
+
+
+def _cfg_ulysses(parallel):
+    import dataclasses
+    return dataclasses.replace(_cfg(parallel), cp_impl="ulysses")
+
+
+def test_ulysses_matches_dense(devices8):
+    cfg_cp = _cfg_ulysses(ParallelConfig(data=2, context=4))
+    mesh_cp = build_mesh(cfg_cp.parallel, devices=devices8)
+    cfg_d = _cfg(ParallelConfig(data=1))
+    mesh_d = build_mesh(cfg_d.parallel, devices=devices8[:1])
+    _, l_cp = _run(cfg_cp, mesh_cp)
+    _, l_d = _run(cfg_d, mesh_d)
+    np.testing.assert_allclose(l_cp, l_d, rtol=2e-3, atol=2e-3)
+    assert l_cp[-1] < l_cp[0]
+
+
+def test_ulysses_composes_with_fsdp(devices8):
+    cfg = _cfg_ulysses(ParallelConfig(data=2, fsdp=2, context=2))
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    _, losses = _run(cfg, mesh)
+    assert losses[-1] < losses[0]
+
+
+def test_ulysses_rejects_indivisible_heads(devices8):
+    # 4 heads over context=8 -> clean error at trace time
+    cfg = _cfg_ulysses(ParallelConfig(data=1, context=8))
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    step_fn = engine.make_train_step(cfg, mesh)
+    toks = data.make_synthetic_tokens(8, TINY["max_seq_len"] + 1, 97,
+                                      seed=0)
+    with pytest.raises(ValueError, match="divisible by the context"):
+        step_fn(state, (toks,))

@@ -137,3 +137,12 @@ def test_capacity_is_static_and_sane():
     tight = dataclasses.replace(MODEL, capacity_factor=0.5)
     assert moe.capacity(tight, 64) == 16
     assert moe.capacity(dataclasses.replace(MODEL, n_experts=1000), 4) >= 1
+
+
+def test_expert_axis_rejected_for_non_moe_models():
+    from tpudist.models import transformer  # noqa: F401  (registry warm)
+    cfg = _cfg(data=4, expert=2,
+               model=dataclasses.replace(MODEL, name="transformer"))
+    mesh = build_mesh(cfg.parallel)
+    with pytest.raises(ValueError, match="expert"):
+        engine.make_loss_fn(cfg, mesh)

@@ -83,6 +83,7 @@ class TrainConfig:
     xent_chunks: int = 0          # stream LM head+loss over N seq chunks
     fused_xent: bool = False      # pallas fused LM head+loss (no HBM logits)
     pp_microbatches: int = 0      # pipeline microbatches (0 = pipe size)
+    cp_impl: str = "ring"         # context parallelism: ring | ulysses
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
@@ -147,10 +148,20 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="expert mesh axis size (MoE expert parallelism)")
     p.add_argument("--pp-microbatches", type=int, default=0,
                    help="pipeline microbatches per step (0 = pipe size)")
+    p.add_argument("--cp-impl", type=str, default="ring",
+                   choices=["ring", "ulysses"],
+                   help="context-parallel attention: kv ring rotation "
+                        "(zigzag causal balance, scales past head count) "
+                        "or ulysses all-to-all head resharding")
     # moe shape
     p.add_argument("--n-experts", type=int, default=8)
     p.add_argument("--expert-top-k", type=int, default=2)
     p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--router-aux-weight", type=float, default=0.01)
+    p.add_argument("--moe-group-size", type=int, default=4096,
+                   help="tokens per routing group (bounds dispatch-tensor "
+                        "memory; must divide batch*seq or routing falls "
+                        "back to one global group)")
     p.add_argument("--fail-at", type=int, default=None,
                    help="fault injection: fail after this epoch (replaces the "
                         "reference's commented-out sys.exit(1), train.py:129)")
@@ -174,6 +185,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         xent_chunks=args.xent_chunks,
         fused_xent=args.fused_xent,
         pp_microbatches=args.pp_microbatches,
+        cp_impl=args.cp_impl,
         fail_at=args.fail_at,
         log_every=args.log_every,
         profile_dir=args.profile_dir,
@@ -187,7 +199,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                           d_ff=args.d_ff, max_seq_len=args.seq_len,
                           n_experts=args.n_experts,
                           expert_top_k=args.expert_top_k,
-                          capacity_factor=args.capacity_factor),
+                          capacity_factor=args.capacity_factor,
+                          router_aux_weight=args.router_aux_weight,
+                          moe_group_size=args.moe_group_size),
         parallel=ParallelConfig(pipe=args.pipe, fsdp=args.fsdp,
                                 expert=args.expert, tensor=args.tensor,
                                 context=args.context),

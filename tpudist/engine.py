@@ -62,6 +62,12 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
     fully-manual shard_map DP path is an error."""
     model = get_model(cfg.model.name)
     dt = _compute_dtype(cfg)
+    if (mesh is not None and mesh.shape.get("expert", 1) > 1
+            and cfg.model.name != "moe"):
+        # without expert-sharded weights the axis silently replicates all
+        # compute — half the slice doing duplicate work is a config error
+        raise ValueError(f"--expert > 1 requires --model moe; "
+                         f"{cfg.model.name!r} has no expert-sharded params")
     if cfg.model.name == "mlp":
         if mesh is not None and mesh.shape.get("pipe", 1) > 1:
             raise ValueError("pipeline parallelism requires a layered "
@@ -100,7 +106,8 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
         cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt,
                                         remat=cfg.remat,
                                         xent_chunks=cfg.xent_chunks,
-                                        fused_xent=cfg.fused_xent)
+                                        fused_xent=cfg.fused_xent,
+                                        impl=cfg.cp_impl)
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch

@@ -64,28 +64,18 @@ def group_size(cfg: ModelConfig, n_tokens: int) -> int:
 
 
 def init(key: jax.Array, cfg: ModelConfig) -> Params:
-    d, h, kv, L = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    d, L = cfg.d_model, cfg.n_layers
     E, dff = cfg.n_experts, cfg.d_ff
-    hd = d // h
     keys = jax.random.split(key, 10)
 
-    def w(key, *shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32)
-                * (1.0 / jnp.sqrt(fan_in)))
-
     return {
-        "embed": w(keys[0], cfg.vocab_size, d, fan_in=d),
+        "embed": T._w(keys[0], cfg.vocab_size, d, fan_in=d),
         "layers": {
-            "attn_norm": jnp.ones((L, d), jnp.float32),
-            "wq": w(keys[1], L, d, h * hd, fan_in=d),
-            "wk": w(keys[2], L, d, kv * hd, fan_in=d),
-            "wv": w(keys[3], L, d, kv * hd, fan_in=d),
-            "wo": w(keys[4], L, h * hd, d, fan_in=h * hd),
-            "ffn_norm": jnp.ones((L, d), jnp.float32),
-            "w_router": w(keys[5], L, d, E, fan_in=d),
-            "w_gate": w(keys[6], L, E, d, dff, fan_in=d),
-            "w_up": w(keys[7], L, E, d, dff, fan_in=d),
-            "w_down": w(keys[8], L, E, dff, d, fan_in=dff),
+            **T.attn_block_init(keys[1:5], cfg),
+            "w_router": T._w(keys[5], L, d, E, fan_in=d),
+            "w_gate": T._w(keys[6], L, E, d, dff, fan_in=d),
+            "w_up": T._w(keys[7], L, E, d, dff, fan_in=d),
+            "w_down": T._w(keys[8], L, E, dff, d, fan_in=dff),
         },
         "final_norm": jnp.ones((d,), jnp.float32),
     }

@@ -66,35 +66,43 @@ def rmsnorm(x: jax.Array, g: jax.Array, eps: float = 1e-6) -> jax.Array:
     return (xf * scale).astype(x.dtype) * g.astype(x.dtype)
 
 
+def _w(key, *shape, fan_in):
+    return (jax.random.normal(key, shape, jnp.float32)
+            * (1.0 / jnp.sqrt(fan_in)))
+
+
+def attn_block_init(keys: jax.Array, cfg: ModelConfig) -> Params:
+    """Attention-half weights plus both norms, for all layers stacked.
+    Shared with the MoE model, whose layers differ only in the FFN half
+    (matching the shared forward, ``_attn_sublayer``). ``keys``: 4 PRNG
+    keys for wq/wk/wv/wo."""
+    d, h, kv, L = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.n_layers
+    hd = d // h
+    return {
+        "attn_norm": jnp.ones((L, d), jnp.float32),
+        "wq": _w(keys[0], L, d, h * hd, fan_in=d),
+        "wk": _w(keys[1], L, d, kv * hd, fan_in=d),
+        "wv": _w(keys[2], L, d, kv * hd, fan_in=d),
+        "wo": _w(keys[3], L, h * hd, d, fan_in=h * hd),
+        "ffn_norm": jnp.ones((L, d), jnp.float32),
+    }
+
+
 def init(key: jax.Array, cfg: ModelConfig) -> Params:
     """Params pytree. Per-layer weights are stacked on a leading n_layers dim
     so the forward can lax.scan over them."""
-    d, h, kv, dff, L = (cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.d_ff,
-                        cfg.n_layers)
-    hd = d // h
+    d, dff, L = cfg.d_model, cfg.d_ff, cfg.n_layers
     keys = jax.random.split(key, 8)
 
-    def norm_init(*shape):
-        return jnp.ones(shape, jnp.float32)
-
-    def w(key, *shape, fan_in):
-        return (jax.random.normal(key, shape, jnp.float32)
-                * (1.0 / jnp.sqrt(fan_in)))
-
     return {
-        "embed": w(keys[0], cfg.vocab_size, d, fan_in=d),  # also output head
+        "embed": _w(keys[0], cfg.vocab_size, d, fan_in=d),  # also output head
         "layers": {
-            "attn_norm": norm_init(L, d),
-            "wq": w(keys[1], L, d, h * hd, fan_in=d),
-            "wk": w(keys[2], L, d, kv * hd, fan_in=d),
-            "wv": w(keys[3], L, d, kv * hd, fan_in=d),
-            "wo": w(keys[4], L, h * hd, d, fan_in=h * hd),
-            "ffn_norm": norm_init(L, d),
-            "w_gate": w(keys[5], L, d, dff, fan_in=d),
-            "w_up": w(keys[6], L, d, dff, fan_in=d),
-            "w_down": w(keys[7], L, dff, d, fan_in=dff),
+            **attn_block_init(keys[1:5], cfg),
+            "w_gate": _w(keys[5], L, d, dff, fan_in=d),
+            "w_up": _w(keys[6], L, d, dff, fan_in=d),
+            "w_down": _w(keys[7], L, dff, d, fan_in=dff),
         },
-        "final_norm": norm_init(d),
+        "final_norm": jnp.ones((d,), jnp.float32),
     }
 
 
@@ -349,43 +357,60 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
 
 def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
                     dtype=jnp.bfloat16, remat: bool = False,
-                    xent_chunks: int = 0, fused_xent: bool = False):
-    """Context-parallel loss: sequence sharded over ``axis`` in the zigzag
-    layout (each shard holds one early + one late chunk — balanced causal
-    work), attention via ring attention (tpudist.ops.ring_attention), RoPE
-    from per-shard absolute positions.
+                    xent_chunks: int = 0, fused_xent: bool = False,
+                    impl: str = "ring"):
+    """Context-parallel loss: sequence sharded over ``axis``.
+
+    ``impl="ring"`` (default): zigzag layout (each shard holds one early +
+    one late chunk — balanced causal work), attention via ring attention
+    (tpudist.ops.ring_attention), RoPE from per-shard absolute positions;
+    the zigzag permutation happens BEFORE sharding and the loss (a token
+    mean) needs no inverse. ``impl="ulysses"``: contiguous shards, two
+    all-to-alls reshard heads↔sequence around plain full-sequence
+    attention (tpudist.ops.ulysses) — requires head counts divisible by
+    the axis size.
 
     Only the ``axis`` mesh dimension is manualized (shard_map axis_names);
-    data/fsdp/tensor sharding of batch and params continues to flow through
-    the SPMD partitioner outside/inside the manual region. The token shift
-    and the zigzag permutation happen BEFORE sharding, so no halo exchange
-    is needed and the loss (a token mean) needs no inverse permutation;
-    (seq_len) of the shifted inputs must divide by 2 × the axis size.
+    data/fsdp/tensor sharding of batch and params continues to flow
+    through the SPMD partitioner outside/inside the manual region. No halo
+    exchange is needed either way; (seq_len) of the shifted inputs must
+    divide by 2 × the axis size (ring) or the axis size (ulysses).
     """
-    from tpudist.ops.ring_attention import ring_attention_local, \
-        zigzag_permute, zigzag_positions
-
     if fused_xent and xent_chunks:
         raise ValueError("--fused-xent and --xent-chunks are mutually "
                          "exclusive LM-head strategies")
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
     n_ctx = mesh.shape[axis]
 
     def loss(params: Params, tokens: jax.Array) -> jax.Array:
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        inputs = zigzag_permute(inputs, n_ctx)
-        targets = zigzag_permute(targets, n_ctx)
+        if impl == "ring":
+            from tpudist.ops.ring_attention import zigzag_permute
+            inputs = zigzag_permute(inputs, n_ctx)
+            targets = zigzag_permute(targets, n_ctx)
 
         def body(params, inputs, targets):
             s_local = inputs.shape[1]
-            pos = zigzag_positions(lax.axis_index(axis), s_local, n_ctx)
+            if impl == "ring":
+                from tpudist.ops.ring_attention import (
+                    ring_attention_local, zigzag_positions)
+                pos, off = zigzag_positions(lax.axis_index(axis), s_local,
+                                            n_ctx), 0
 
-            def attn(q, k, v):
-                return ring_attention_local(q, k, v, axis, causal=True,
-                                            layout="zigzag")
+                def attn(q, k, v):
+                    return ring_attention_local(q, k, v, axis, causal=True,
+                                                layout="zigzag")
+            else:
+                from tpudist.ops.ulysses import ulysses_attention
+                pos, off = None, lax.axis_index(axis) * s_local
+
+                def attn(q, k, v):
+                    return ulysses_attention(q, k, v, axis)
 
             h = hidden_states(params, inputs, cfg, dtype=dtype,
                               attn_impl=attn, rope_positions=pos,
-                              remat=remat)
+                              rope_offset=off, remat=remat)
             local = head_loss(params["embed"].astype(dtype), h, targets,
                               xent_chunks=xent_chunks,
                               fused_xent=fused_xent)

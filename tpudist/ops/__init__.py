@@ -1,3 +1,3 @@
-from tpudist.ops import collectives, ring_attention
+from tpudist.ops import collectives, ring_attention, ulysses
 
-__all__ = ["collectives", "ring_attention"]
+__all__ = ["collectives", "ring_attention", "ulysses"]
