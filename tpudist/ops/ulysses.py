@@ -49,8 +49,8 @@ def ulysses_attention(q: jax.Array, k: jax.Array, v: jax.Array,
         if x % n:
             raise ValueError(
                 f"ulysses needs {name} ({x}) divisible by the context "
-                f"axis size ({n}); use --cp-impl ring for head counts "
-                f"below the axis size")
+                f"axis size ({n}); use --cp-impl ring when the head "
+                f"count doesn't factor over the axis")
 
     def seq_to_heads(x):
         # (b, s/n, h, hd) -> (b, s, h/n, hd)

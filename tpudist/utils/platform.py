@@ -23,14 +23,15 @@ def tune_tpu(scoped_vmem_kib: int | None = None) -> None:
 
     Raising the scoped-VMEM limit from its 16 MiB default lets XLA form
     larger fusions — measured +8% train tokens/s on v5e at the flagship
-    transformer shape (the env snapshot happens at PJRT plugin dlopen, so
-    setting it here works even though jax was imported earlier). Respects
-    an operator-provided LIBTPU_INIT_ARGS that already carries the flag;
+    transformer shape going to 48 MiB, +1% more at 80 MiB (the env
+    snapshot happens at PJRT plugin dlopen, so setting it here works even
+    though jax was imported earlier). Respects an operator-provided
+    LIBTPU_INIT_ARGS that already carries the flag;
     ``TPUDIST_SCOPED_VMEM_KIB=0`` disables, other values override."""
     if scoped_vmem_kib is None:
         raw = os.environ.get("TPUDIST_SCOPED_VMEM_KIB", "").strip()
         try:
-            scoped_vmem_kib = int(raw) if raw else 49152
+            scoped_vmem_kib = int(raw) if raw else 81920
         except ValueError:
             print(f"tpudist: ignoring non-integer "
                   f"TPUDIST_SCOPED_VMEM_KIB={raw!r}")
