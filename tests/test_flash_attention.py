@@ -113,3 +113,26 @@ def test_supports_gates_shapes():
     assert not fa.supports((1, 200, 2, 128), ok[1])      # seq not /128
     assert not fa.supports((1, 256, 2, 64), ok[1])       # head_dim 64
     assert not fa.supports((1, 256, 3, 128), ok[1])      # heads not /kv
+
+
+def test_fused_rope_matches_rotate_then_attend():
+    from tpudist.models.transformer import apply_rope, precompute_rope
+    q, k, v = _data()
+    cos, sin = precompute_rope(q.shape[1], q.shape[-1])
+    got = fa.flash_attention(q, k, v, cos=cos, sin=sin, block_q=128,
+                             block_k=128, interpret=True)
+    want = _dense_ref(apply_rope(q, cos, sin), apply_rope(k, cos, sin), v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+    # gradients flow through the in-kernel rotation and counter-rotation
+    ct = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    got_g = jax.grad(lambda a, b, c: jnp.vdot(fa.flash_attention(
+        a, b, c, cos=cos, sin=sin, block_q=128, block_k=128,
+        interpret=True), ct), argnums=(0, 1, 2))(q, k, v)
+    want_g = jax.grad(lambda a, b, c: jnp.vdot(_dense_ref(
+        apply_rope(a, cos, sin), apply_rope(b, cos, sin), c), ct),
+        argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(got_g, want_g, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")

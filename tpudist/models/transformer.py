@@ -128,7 +128,7 @@ def _use_flash(q_shape, k_shape) -> bool:
     return fa.supports(q_shape, k_shape)
 
 
-def _attention(q, k, v, *, causal: bool = True):
+def _attention(q, k, v, *, causal: bool = True, cos=None, sin=None):
     """Local attention. q: (batch, seq, heads, head_dim); k/v may carry
     fewer (grouped-query) kv heads and are expanded here. On TPU, aligned
     shapes run the pallas flash kernel (scores never in HBM — measured
@@ -136,10 +136,18 @@ def _attention(q, k, v, *, causal: bool = True):
     otherwise route to the blockwise O(s·chunk)-memory path (the dense
     score tensor is gigabytes at seq 4096 and fails to compile on one
     chip). Ring/context-parallel execution swaps this whole function for
-    tpudist.ops.ring_attention at the shard_map level."""
+    tpudist.ops.ring_attention at the shard_map level.
+
+    ``cos``/``sin``: optional RoPE tables, (seq, head_dim/2). When given,
+    q/k arrive UNROTATED and the rotation happens here — fused into the
+    flash kernel on TPU (saves the rotated tensors' HBM round-trip),
+    applied up front otherwise."""
     if _use_flash(q.shape, k.shape):
         from tpudist.ops.pallas.flash_attention import flash_attention
-        return flash_attention(q, k, v, causal=causal)
+        return flash_attention(q, k, v, cos=cos, sin=sin, causal=causal)
+    if cos is not None:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
     if causal and q.shape[1] >= _BLOCKWISE_MIN_SEQ \
             and q.shape[1] == k.shape[1] \
             and q.shape[1] % _BLOCKWISE_CHUNK == 0:
@@ -158,6 +166,12 @@ def _attention(q, k, v, *, causal: bool = True):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+# capability marker for _layer's dispatch: impls that take cos/sin and
+# rotate internally (wrappers should copy this attribute to keep the
+# fused-rope path)
+_attention.accepts_rope = True
+
+
 def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     """Pre-norm attention + residual. Shared with the MoE model, whose
     layers differ only in the FFN half."""
@@ -170,11 +184,17 @@ def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     q = (y @ lp["wq"].astype(dt)).reshape(b, s, h, hd)
     k = (y @ lp["wk"].astype(dt)).reshape(b, s, kv, hd)
     v = (y @ lp["wv"].astype(dt)).reshape(b, s, kv, hd)
-    q = apply_rope(q, cos, sin)
-    k = apply_rope(k, cos, sin)
     # GQA: compact kv heads go to the attention impl as-is — ring attention
     # must transfer the small blocks; expansion happens inside the kernel.
-    o = attn_impl(q, k, v).reshape(b, s, h * hd)
+    if getattr(attn_impl, "accepts_rope", False):
+        # rope-aware impls take the tables and rotate internally (the flash
+        # kernel rotates blocks in VMEM — no rotated-tensor HBM round-trip)
+        o = attn_impl(q, k, v, cos=cos, sin=sin)
+    else:
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = attn_impl(q, k, v)
+    o = o.reshape(b, s, h * hd)
     return x + o @ lp["wo"].astype(dt)
 
 

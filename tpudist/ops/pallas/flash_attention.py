@@ -1,4 +1,4 @@
-"""Pallas TPU kernel: flash attention (fwd + custom-VJP bwd).
+"""Pallas TPU kernel: flash attention (fwd + custom-VJP bwd), fused RoPE.
 
 The measured compute hot spot of the transformer workload after the LM head
 is attention: the dense path (models/transformer.py::_attention)
@@ -9,7 +9,7 @@ online softmax (scores never touch HBM) and recomputes them in the backward
 pass (two kernels: dq with kv innermost, dk/dv with q innermost) — the
 standard flash-attention schedule, written for the MXU.
 
-Two TPU-specific schedule choices:
+Three TPU-specific schedule choices:
   * Pallas grid programs execute **sequentially** on the TensorCore, so
     per-program overhead is paid ``grid-size`` times. A (batch·heads)-sized
     grid dimension at seq 512 means ~1500 programs doing ~0.2 µs of matmul
@@ -19,6 +19,12 @@ Two TPU-specific schedule choices:
   * Causal masking skips fully-masked blocks: the kv grid dimension is
     innermost, and a block is computed only when its kv columns intersect
     the causal triangle of the q rows (j·block_k ≤ (i+1)·block_q − 1).
+  * RoPE is applied INSIDE the kernels (pass ``cos``/``sin``): rotating
+    q/k blocks in VMEM removes the rotated tensors' HBM round-trip AND
+    their storage as VJP residuals — profiled at ~10 ms/step of loop
+    fusions at bench shapes. The backward kernels re-rotate q/k for the
+    score recompute and counter-rotate the dq/dk accumulators on the way
+    out (the rotation is orthogonal: Rᵀ = R(−θ)).
 
 The reference has no attention anywhere (its model is a 20-feature MLP,
 reference train.py:26-36); this kernel serves the north-star transformer
@@ -67,6 +73,24 @@ def _last_j(i, nj, block_q: int, block_k: int, causal: bool):
     return jnp.minimum((i * block_q + block_q - 1) // block_k, nj - 1)
 
 
+def _rot(x, cos_ref, sin_ref):
+    """RoPE-rotate a (nb, t, d) block; cos/sin refs hold (t, d/2)."""
+    d2 = x.shape[-1] // 2
+    c = cos_ref[:][None].astype(x.dtype)
+    s = sin_ref[:][None].astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def _rot_t(x, cos_ref, sin_ref):
+    """Transpose (inverse) rotation, for dq/dk cotangents (f32)."""
+    d2 = x.shape[-1] // 2
+    c = cos_ref[:][None].astype(x.dtype)
+    s = sin_ref[:][None].astype(x.dtype)
+    x1, x2 = x[..., :d2], x[..., d2:]
+    return jnp.concatenate([x1 * c + x2 * s, x2 * c - x1 * s], axis=-1)
+
+
 def _block_scores(q, k, scale, i, j, block_q, block_k, causal):
     """(nb, block_q, block_k) f32 scaled scores, causally masked."""
     s = jax.lax.dot_general(q, k, _BMM_NT,
@@ -83,8 +107,13 @@ def _block_scores(q, k, scale, i, j, block_q, block_k, causal):
 # ---------------------------------------------------------------- forward
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
-                *, scale: float, block_q: int, block_k: int, causal: bool):
+def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int,
+                causal: bool, rope: bool):
+    if rope:
+        (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
+         o_ref, lse_ref, m_ref, l_ref, acc_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref = refs
     i, j = pl.program_id(1), pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -97,6 +126,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
         q, k, v = q_ref[:], k_ref[:], v_ref[:]
+        if rope:
+            q = _rot(q, cq_ref, sq_ref)
+            k = _rot(k, ck_ref, sk_ref)
         s = _block_scores(q, k, scale, i, j, block_q, block_k, causal)
         m_prev = m_ref[:]                              # (nb, block_q, 1)
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=2, keepdims=True))
@@ -117,21 +149,44 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[:] = m_ref[:] + jnp.log(l)
 
 
-def _fwd(q, k, v, *, scale, block_b, block_q, block_k, causal, interpret
-         ) -> Tuple[jax.Array, jax.Array]:
+def _rope_specs(d: int, block_q: int, block_k: int, transposed: bool):
+    """cos/sin blockspecs for the q-row and k-row tables: (block, d/2)
+    slices of the (s, d/2) tables, indexed by the q (resp. kv) grid dim."""
+    d2 = d // 2
+    if transposed:      # grid (b, j, i)
+        qrow = pl.BlockSpec((block_q, d2), lambda b, j, i: (i, 0),
+                            memory_space=pltpu.VMEM)
+        krow = pl.BlockSpec((block_k, d2), lambda b, j, i: (j, 0),
+                            memory_space=pltpu.VMEM)
+    else:               # grid (b, i, j)
+        qrow = pl.BlockSpec((block_q, d2), lambda b, i, j: (i, 0),
+                            memory_space=pltpu.VMEM)
+        krow = pl.BlockSpec((block_k, d2), lambda b, i, j: (j, 0),
+                            memory_space=pltpu.VMEM)
+    return [qrow, qrow, krow, krow]
+
+
+def _fwd(q, k, v, cos, sin, *, scale, block_b, block_q, block_k, causal,
+         interpret) -> Tuple[jax.Array, jax.Array]:
     bh, s, d = q.shape
     sk = k.shape[1]
+    rope = cos is not None
     grid = (_cdiv(bh, block_b), _cdiv(s, block_q), _cdiv(sk, block_k))
 
     qspec = pl.BlockSpec((block_b, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
     kspec = pl.BlockSpec((block_b, block_k, d), lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
+    in_specs = [qspec, kspec, kspec]
+    args = [q, k, v]
+    if rope:
+        in_specs += _rope_specs(d, block_q, block_k, transposed=False)
+        args += [cos, sin, cos, sin]
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rope=rope),
         grid=grid,
-        in_specs=[qspec, kspec, kspec],
+        in_specs=in_specs,
         out_specs=[
             qspec,
             pl.BlockSpec((block_b, block_q, 1), lambda b, i, j: (b, i, 0),
@@ -147,7 +202,7 @@ def _fwd(q, k, v, *, scale, block_b, block_q, block_k, causal, interpret
             pltpu.VMEM((block_b, block_q, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(*args)
     return o, lse
 
 
@@ -169,9 +224,14 @@ def _p_and_ds(q, k, v, do, lse, delta, scale, i, j, block_q, block_k,
     return p, ds
 
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               acc_ref, *, scale: float, block_q: int, block_k: int,
-               causal: bool):
+def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
+               causal: bool, rope: bool):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, acc_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, acc_ref) = refs
     i, j = pl.program_id(1), pl.program_id(2)
     nj = pl.num_programs(2)
 
@@ -181,8 +241,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
-        k = k_ref[:]
-        _, ds = _p_and_ds(q_ref[:], k, v_ref[:], do_ref[:], lse_ref[:],
+        q, k = q_ref[:], k_ref[:]
+        if rope:
+            q = _rot(q, cq_ref, sq_ref)
+            k = _rot(k, ck_ref, sk_ref)
+        _, ds = _p_and_ds(q, k, v_ref[:], do_ref[:], lse_ref[:],
                           delta_ref[:], scale, i, j, block_q, block_k,
                           causal)
         acc_ref[:] += jax.lax.dot_general(
@@ -191,12 +254,23 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
     @pl.when(j == _last_j(i, nj, block_q, block_k, causal))
     def _finish():
-        dq_ref[:] = (acc_ref[:] * scale).astype(dq_ref.dtype)
+        dq = acc_ref[:] * scale
+        if rope:
+            # dq was accumulated against rotated k: counter-rotate back to
+            # the unrotated-q frame (Rᵀ of the q-row rotation)
+            dq = _rot_t(dq, cq_ref, sq_ref)
+        dq_ref[:] = dq.astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_acc, dv_acc, *, scale: float,
-                block_q: int, block_k: int, causal: bool):
+def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
+                causal: bool, rope: bool):
+    if rope:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         cq_ref, sq_ref, ck_ref, sk_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
     j, i = pl.program_id(1), pl.program_id(2)   # kv outer, q inner
     ni = pl.num_programs(2)
 
@@ -207,8 +281,11 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
-        q, do = q_ref[:], do_ref[:]
-        p, ds = _p_and_ds(q, k_ref[:], v_ref[:], do, lse_ref[:],
+        q, k, do = q_ref[:], k_ref[:], do_ref[:]
+        if rope:
+            q = _rot(q, cq_ref, sq_ref)
+            k = _rot(k, ck_ref, sk_ref)
+        p, ds = _p_and_ds(q, k, v_ref[:], do, lse_ref[:],
                           delta_ref[:], scale, i, j, block_q, block_k,
                           causal)
         dv_acc[:] += jax.lax.dot_general(
@@ -221,13 +298,17 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # the final q block always attends to every kv block under causality
     @pl.when(i == ni - 1)
     def _finish():
-        dk_ref[:] = (dk_acc[:] * scale).astype(dk_ref.dtype)
+        dk = dk_acc[:] * scale
+        if rope:
+            dk = _rot_t(dk, ck_ref, sk_ref)
+        dk_ref[:] = dk.astype(dk_ref.dtype)
         dv_ref[:] = dv_acc[:].astype(dv_ref.dtype)
 
 
 def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
-    q, k, v, o, lse = res
+    q, k, v, o, lse, cos, sin = res
     do = ct
+    rope = cos is not None
     bh, s, d = q.shape
     sk = k.shape[1]
     # softmax-jacobian row constant, cheap elementwise fuse outside pallas
@@ -241,13 +322,17 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     rowspec = pl.BlockSpec((block_b, block_q, 1),
                            lambda b, i, j: (b, i, 0),
                            memory_space=pltpu.VMEM)
-    args = (q, k, v, do, lse, delta)
+    args = [q, k, v, do, lse, delta]
+    in_specs = [qspec, kspec, kspec, qspec, rowspec, rowspec]
+    if rope:
+        in_specs += _rope_specs(d, block_q, block_k, transposed=False)
+        args += [cos, sin, cos, sin]
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rope=rope),
         grid=(_cdiv(bh, block_b), _cdiv(s, block_q), _cdiv(sk, block_k)),
-        in_specs=[qspec, kspec, kspec, qspec, rowspec, rowspec],
+        in_specs=in_specs,
         out_specs=qspec,
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_b, block_q, d), jnp.float32)],
@@ -263,12 +348,18 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     rowspec_t = pl.BlockSpec((block_b, block_q, 1),
                              lambda b, j, i: (b, i, 0),
                              memory_space=pltpu.VMEM)
+    args_t = [q, k, v, do, lse, delta]
+    in_specs_t = [qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t]
+    if rope:
+        in_specs_t += _rope_specs(d, block_q, block_k, transposed=True)
+        args_t += [cos, sin, cos, sin]
+    kvout = kspec_t
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
-                          block_k=block_k, causal=causal),
+                          block_k=block_k, causal=causal, rope=rope),
         grid=(_cdiv(bh, block_b), _cdiv(sk, block_k), _cdiv(s, block_q)),
-        in_specs=[qspec_t, kspec_t, kspec_t, qspec_t, rowspec_t, rowspec_t],
-        out_specs=[kspec_t, kspec_t],
+        in_specs=in_specs_t,
+        out_specs=[kvout, kvout],
         out_shape=[
             jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
             jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
@@ -278,22 +369,27 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
             pltpu.VMEM((block_b, block_k, d), jnp.float32),
         ],
         interpret=interpret,
-    )(*args)
-    return dq, dk, dv
+    )(*args_t)
+    dcos = None if cos is None else jnp.zeros_like(cos)
+    dsin = None if sin is None else jnp.zeros_like(sin)
+    return dq, dk, dv, dcos, dsin
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, block_b, block_q, block_k, causal, interpret):
-    o, _ = _fwd(q, k, v, scale=scale, block_b=block_b, block_q=block_q,
-                block_k=block_k, causal=causal, interpret=interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q, k, v, cos, sin, scale, block_b, block_q, block_k, causal,
+           interpret):
+    o, _ = _fwd(q, k, v, cos, sin, scale=scale, block_b=block_b,
+                block_q=block_q, block_k=block_k, causal=causal,
+                interpret=interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, block_b, block_q, block_k, causal,
-               interpret):
-    o, lse = _fwd(q, k, v, scale=scale, block_b=block_b, block_q=block_q,
-                  block_k=block_k, causal=causal, interpret=interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, cos, sin, scale, block_b, block_q, block_k,
+               causal, interpret):
+    o, lse = _fwd(q, k, v, cos, sin, scale=scale, block_b=block_b,
+                  block_q=block_q, block_k=block_k, causal=causal,
+                  interpret=interpret)
+    return o, (q, k, v, o, lse, cos, sin)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -325,6 +421,8 @@ def supports(q_shape, k_shape, *, block_q: int = 512,
 
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    cos: jax.Array | None = None,
+                    sin: jax.Array | None = None,
                     causal: bool = True, block_b: int = 8,
                     block_q: int = 512, block_k: int = 512,
                     interpret: bool | None = None) -> jax.Array:
@@ -334,9 +432,12 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     head_dim) — grouped-query heads are expanded here (outside the VJP, so
     dk/dv group-sums fall out of the repeat's transpose). Layout matches
     models/transformer.py::_attention, which this replaces on TPU.
+    ``cos``/``sin``: optional (seq, head_dim/2) RoPE tables — when given,
+    q and k are rotated inside the kernels (see module docstring); the
+    tables are positional constants, their cotangent is zero.
     ``block_b`` batch·head slices share one program (sequential-grid
-    amortisation, see module docstring); ``interpret=None`` auto-selects
-    the pallas interpreter off-TPU so the same code path is CPU-testable.
+    amortisation); ``interpret=None`` auto-selects the pallas interpreter
+    off-TPU so the same code path is CPU-testable.
     """
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
@@ -350,11 +451,19 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"flash_attention needs seq multiples of 128 and head_dim "
             f"multiples of 128, got q {q.shape}, k {k.shape}; gate call "
             f"sites on flash_attention.supports()")
+    if cos is not None and (s != sk or cos.shape != (s, hd // 2)
+                            or sin.shape != cos.shape):
+        raise ValueError(
+            f"rope tables must be (seq, head_dim/2) = ({s}, {hd // 2}) "
+            f"with seq == seq_k, got cos {cos.shape}, sin {sin.shape}, "
+            f"seq_k {sk}")
     nb = _pick_block_b(b * h, block_b)
 
     def to3(x):
         return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], hd)
 
-    o = _flash(to3(q), to3(k), to3(v), 1.0 / (hd ** 0.5), nb, bq, bk,
-               causal, interpret)
+    cosf = None if cos is None else cos.astype(jnp.float32)
+    sinf = None if sin is None else sin.astype(jnp.float32)
+    o = _flash(to3(q), to3(k), to3(v), cosf, sinf, 1.0 / (hd ** 0.5), nb,
+               bq, bk, causal, interpret)
     return o.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
