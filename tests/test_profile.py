@@ -1,0 +1,44 @@
+"""tpudist.bench.profile: trace capture + xplane parsing + summary table.
+
+The capture path runs on the CPU backend (jax.profiler works there too),
+so the whole pipeline is testable without hardware; only the achieved
+FLOP/bandwidth columns are TPU-specific.
+"""
+
+import json
+
+import pytest
+
+from tpudist.bench import profile as prof
+
+
+def test_summarize_aggregates_per_step():
+    ops = [
+        {"category": "convolution fusion", "hlo_op_name": "fusion.1",
+         "total_self_time": 1000.0, "bound_by": "Compute",
+         "model_flop_rate": 1.0, "measured_memory_bw": 2.0},
+        {"category": "loop fusion", "hlo_op_name": "fusion.2",
+         "total_self_time": 500.0, "bound_by": "HBM",
+         "model_flop_rate": None, "measured_memory_bw": None},
+    ]
+    s = prof.summarize(ops, n_steps=5, top=1)
+    assert s["total_us_per_step"] == 300.0
+    assert s["by_category_us"]["convolution fusion"] == 200.0
+    assert len(s["top_ops"]) == 1
+    assert s["top_ops"][0]["name"] == "fusion.1"
+
+
+def test_profile_end_to_end_cpu(tmp_path):
+    pytest.importorskip("xprof")
+    rc = prof.main([
+        "--steps", "2", "--top", "3",
+        "--trace-dir", str(tmp_path / "trace"),
+        "--out", str(tmp_path / "prof.json"),
+        "--train-batch-size", "16", "--n-samples", "16",
+    ])
+    assert rc == 0
+    s = json.loads((tmp_path / "prof.json").read_text())
+    # CPU xplanes carry no per-op device times (totals are 0 there); the
+    # nonzero-time end-to-end assertion lives in the TPU lane
+    assert s["total_us_per_step"] >= 0
+    assert "by_category_us" in s and "top_ops" in s

@@ -162,3 +162,25 @@ def test_moe_train_step_smoke_on_chip():
     state, l0 = step(state, (toks,))
     state, l1 = step(state, (toks,))
     assert np.isfinite(float(l0)) and float(l1) < float(l0)
+
+
+def test_profile_tool_reports_device_time_on_chip(tmp_path):
+    """tpudist.bench.profile end-to-end on the chip: nonzero per-op device
+    times, matmuls dominating."""
+    import pytest
+    pytest.importorskip("xprof")
+    import json as _json
+
+    from tpudist.bench import profile as prof
+    rc = prof.main([
+        "--steps", "2", "--top", "5",
+        "--trace-dir", str(tmp_path / "trace"),
+        "--out", str(tmp_path / "prof.json"),
+        "--model", "transformer", "--train-batch-size", "4",
+        "--n-samples", "4", "--seq-len", "256", "--n-layers", "2",
+        "--dtype", "bfloat16",
+    ])
+    assert rc == 0
+    s = _json.loads((tmp_path / "prof.json").read_text())
+    assert s["total_us_per_step"] > 0
+    assert s["by_category_us"].get("convolution fusion", 0) > 0
