@@ -259,7 +259,10 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
                                  in_specs=(P(), bspecs),
                                  out_specs=(P(), P()), check_vma=False)
             return spmd(state, batch)
-        jitted = jax.jit(jitted)
+        # donate the incoming state like the general path does: the update
+        # writes in place instead of carrying two copies of params+opt
+        # state per step
+        jitted = jax.jit(jitted, donate_argnums=(0,))
 
         def step(state, batch):
             return jitted(state, shd.put_batch(mesh, batch))
