@@ -155,11 +155,16 @@ def _moe_layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
 
 def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                   dtype=jnp.bfloat16, attn_impl=T._attention,
+                  rope_offset=0, rope_positions=None,
                   remat: bool = False) -> Tuple[jax.Array, jax.Array]:
-    """Backbone forward → (final-norm hidden states, mean aux loss)."""
+    """Backbone forward → (final-norm hidden states, mean aux loss).
+    ``rope_offset``/``rope_positions``: per-shard absolute positions for
+    context-parallel callers (same contract as the dense transformer)."""
     s = tokens.shape[1]
     hd = cfg.d_model // cfg.n_heads
-    cos, sin = T.precompute_rope(s, hd, cfg.rope_theta)
+    cos, sin = T.precompute_rope(s, hd, cfg.rope_theta,
+                                 offset=rope_offset,
+                                 positions=rope_positions)
     x = params["embed"].astype(dtype)[tokens]
 
     def body(carry, lp):
@@ -215,3 +220,29 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                        xent_chunks=xent_chunks, fused_xent=fused_xent,
                        logits_sharding=logits_sharding)
     return xent + cfg.router_aux_weight * aux
+
+
+def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
+                    dtype=jnp.bfloat16, remat: bool = False,
+                    xent_chunks: int = 0, fused_xent: bool = False,
+                    impl: str = "ring"):
+    """Context-parallel MoE loss: same sharding scheme as the dense
+    transformer's (:func:`transformer.make_cp_loss_fn` — zigzag ring or
+    Ulysses via ``impl``), with the MoE particulars: each context shard
+    routes its OWN sequence slice (group-local routing over local tokens,
+    consistent with the model's grouping semantics — token order within
+    the shard doesn't change the math when capacity is ample), and the
+    router aux loss is pmean'd along with the xent."""
+    if fused_xent and xent_chunks:
+        raise ValueError("--fused-xent and --xent-chunks are mutually "
+                         "exclusive LM-head strategies")
+
+    def shard_loss(params, inputs, targets, attn, pos, off):
+        h, aux = hidden_states(params, inputs, cfg, dtype=dtype,
+                               attn_impl=attn, rope_positions=pos,
+                               rope_offset=off, remat=remat)
+        local = T.head_loss(params["embed"].astype(dtype), h, targets,
+                            xent_chunks=xent_chunks, fused_xent=fused_xent)
+        return local + cfg.router_aux_weight * aux
+
+    return T.make_cp_loss(mesh, shard_loss, axis=axis, impl=impl)

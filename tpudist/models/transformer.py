@@ -375,6 +375,68 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                      logits_sharding=logits_sharding)
 
 
+def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int):
+    """Per-shard attention impl + RoPE position info for a context-
+    parallel body. Returns (attn_fn, rope_positions, rope_offset) —
+    exactly one of positions/offset is meaningful (zigzag shards hold two
+    non-adjacent chunks; ulysses shards are contiguous). Shared by the
+    transformer and MoE cp loss builders."""
+    if impl == "ring":
+        from tpudist.ops.ring_attention import (ring_attention_local,
+                                                zigzag_positions)
+        pos = zigzag_positions(lax.axis_index(axis), s_local, n_ctx)
+
+        def attn(q, k, v):
+            return ring_attention_local(q, k, v, axis, causal=True,
+                                        layout="zigzag")
+        return attn, pos, 0
+    if impl == "ulysses":
+        from tpudist.ops.ulysses import ulysses_attention
+
+        def attn(q, k, v):
+            return ulysses_attention(q, k, v, axis)
+        return attn, None, lax.axis_index(axis) * s_local
+    raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
+
+
+def make_cp_loss(mesh, shard_loss_fn, *, axis: str = "context",
+                 impl: str = "ring"):
+    """Shared context-parallel scaffolding for every sequence model.
+
+    ``shard_loss_fn(params, inputs, targets, attn, pos, off) -> scalar``
+    computes one shard's local loss given the per-shard attention impl and
+    RoPE position info (from :func:`cp_attention`); this wrapper owns the
+    impl validation, the zigzag pre-permute (ring), the shard_map (only
+    ``axis`` manualized — data/fsdp/tensor/expert sharding keeps flowing
+    through the SPMD partitioner), and the pmean. No halo exchange is
+    needed either way; (seq_len) of the shifted inputs must divide by
+    2 × the axis size (ring) or the axis size (ulysses).
+    """
+    if impl not in ("ring", "ulysses"):
+        raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
+    n_ctx = mesh.shape[axis]
+
+    def loss(params, tokens: jax.Array) -> jax.Array:
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if impl == "ring":
+            from tpudist.ops.ring_attention import zigzag_permute
+            inputs = zigzag_permute(inputs, n_ctx)
+            targets = zigzag_permute(targets, n_ctx)
+
+        def body(params, inputs, targets):
+            attn, pos, off = cp_attention(impl, axis, n_ctx,
+                                          inputs.shape[1])
+            local = shard_loss_fn(params, inputs, targets, attn, pos, off)
+            return lax.pmean(local, axis)
+
+        return jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P(None, axis), P(None, axis)),
+            out_specs=P(), axis_names=frozenset({axis}),
+            check_vma=False)(params, inputs, targets)
+    return loss
+
+
 def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
                     dtype=jnp.bfloat16, remat: bool = False,
                     xent_chunks: int = 0, fused_xent: bool = False,
@@ -388,57 +450,18 @@ def make_cp_loss_fn(cfg: ModelConfig, mesh, *, axis: str = "context",
     mean) needs no inverse. ``impl="ulysses"``: contiguous shards, two
     all-to-alls reshard heads↔sequence around plain full-sequence
     attention (tpudist.ops.ulysses) — requires head counts divisible by
-    the axis size.
-
-    Only the ``axis`` mesh dimension is manualized (shard_map axis_names);
-    data/fsdp/tensor sharding of batch and params continues to flow
-    through the SPMD partitioner outside/inside the manual region. No halo
-    exchange is needed either way; (seq_len) of the shifted inputs must
-    divide by 2 × the axis size (ring) or the axis size (ulysses).
+    the axis size. Scaffolding shared with the MoE model
+    (:func:`make_cp_loss`).
     """
     if fused_xent and xent_chunks:
         raise ValueError("--fused-xent and --xent-chunks are mutually "
                          "exclusive LM-head strategies")
-    if impl not in ("ring", "ulysses"):
-        raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
-    n_ctx = mesh.shape[axis]
 
-    def loss(params: Params, tokens: jax.Array) -> jax.Array:
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        if impl == "ring":
-            from tpudist.ops.ring_attention import zigzag_permute
-            inputs = zigzag_permute(inputs, n_ctx)
-            targets = zigzag_permute(targets, n_ctx)
+    def shard_loss(params, inputs, targets, attn, pos, off):
+        h = hidden_states(params, inputs, cfg, dtype=dtype,
+                          attn_impl=attn, rope_positions=pos,
+                          rope_offset=off, remat=remat)
+        return head_loss(params["embed"].astype(dtype), h, targets,
+                         xent_chunks=xent_chunks, fused_xent=fused_xent)
 
-        def body(params, inputs, targets):
-            s_local = inputs.shape[1]
-            if impl == "ring":
-                from tpudist.ops.ring_attention import (
-                    ring_attention_local, zigzag_positions)
-                pos, off = zigzag_positions(lax.axis_index(axis), s_local,
-                                            n_ctx), 0
-
-                def attn(q, k, v):
-                    return ring_attention_local(q, k, v, axis, causal=True,
-                                                layout="zigzag")
-            else:
-                from tpudist.ops.ulysses import ulysses_attention
-                pos, off = None, lax.axis_index(axis) * s_local
-
-                def attn(q, k, v):
-                    return ulysses_attention(q, k, v, axis)
-
-            h = hidden_states(params, inputs, cfg, dtype=dtype,
-                              attn_impl=attn, rope_positions=pos,
-                              rope_offset=off, remat=remat)
-            local = head_loss(params["embed"].astype(dtype), h, targets,
-                              xent_chunks=xent_chunks,
-                              fused_xent=fused_xent)
-            return lax.pmean(local, axis)
-
-        return jax.shard_map(
-            body, mesh=mesh,
-            in_specs=(P(), P(None, axis), P(None, axis)),
-            out_specs=P(), axis_names=frozenset({axis}),
-            check_vma=False)(params, inputs, targets)
-    return loss
+    return make_cp_loss(mesh, shard_loss, axis=axis, impl=impl)

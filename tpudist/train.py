@@ -49,13 +49,17 @@ def run(cfg: TrainConfig) -> float:
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
 
     if mesh.shape["context"] > 1:
-        if cfg.model.name != "transformer":
+        if cfg.model.name not in ("transformer", "moe"):
             raise ValueError("--context > 1 (sequence parallelism) requires "
-                             "--model transformer")
-        if cfg.model.max_seq_len % mesh.shape["context"]:
+                             "a sequence model (--model transformer|moe)")
+        # ring's zigzag layout needs 2 chunks per shard; ulysses needs one
+        ways = (2 * mesh.shape["context"] if cfg.cp_impl == "ring"
+                else mesh.shape["context"])
+        if cfg.model.max_seq_len % ways:
             raise ValueError(
                 f"--seq-len {cfg.model.max_seq_len} must be divisible by "
-                f"--context {mesh.shape['context']}")
+                f"{'2x' if cfg.cp_impl == 'ring' else ''}--context "
+                f"{mesh.shape['context']} (cp-impl {cfg.cp_impl})")
 
     batch_ways = mesh.shape["data"] * mesh.shape["fsdp"]
     if cfg.batch_size % batch_ways:
