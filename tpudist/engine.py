@@ -54,8 +54,9 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
                  constrain_logits: bool = False) -> Callable:
     """(params, batch) -> scalar loss, for the configured model.
 
-    With a mesh whose ``context`` axis is >1, the transformer loss runs
-    context-parallel (sequence sharded, ring attention).
+    With a mesh whose ``context`` axis is >1, any model providing
+    ``make_cp_loss_fn`` (transformer, moe) runs context-parallel —
+    sequence sharded, ring or ulysses attention per ``cfg.cp_impl``.
 
     ``constrain_logits`` is only legal (and only needed) under the
     jit+shardings train path — a NamedSharding constraint inside the
@@ -85,10 +86,6 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
             raise ValueError(
                 "the pipeline path computes the plain whole-logits head "
                 "per microbatch; --fused-xent/--xent-chunks do not apply")
-        if cfg.model.name != "transformer":
-            raise ValueError(
-                "pipeline parallelism currently supports the dense "
-                "transformer (the pp slot body runs transformer layers)")
         from tpudist.parallel.pipeline import make_pp_loss_fn
         pp_loss = make_pp_loss_fn(cfg.model, mesh,
                                   n_microbatches=cfg.pp_microbatches,

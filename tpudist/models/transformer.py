@@ -375,6 +375,9 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                      logits_sharding=logits_sharding)
 
 
+CP_IMPLS = ("ring", "ulysses")
+
+
 def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int):
     """Per-shard attention impl + RoPE position info for a context-
     parallel body. Returns (attn_fn, rope_positions, rope_offset) —
@@ -396,7 +399,7 @@ def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int):
         def attn(q, k, v):
             return ulysses_attention(q, k, v, axis)
         return attn, None, lax.axis_index(axis) * s_local
-    raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
+    raise ValueError(f"unknown cp impl {impl!r}: {' | '.join(CP_IMPLS)}")
 
 
 def make_cp_loss(mesh, shard_loss_fn, *, axis: str = "context",
@@ -412,8 +415,8 @@ def make_cp_loss(mesh, shard_loss_fn, *, axis: str = "context",
     needed either way; (seq_len) of the shifted inputs must divide by
     2 × the axis size (ring) or the axis size (ulysses).
     """
-    if impl not in ("ring", "ulysses"):
-        raise ValueError(f"unknown cp impl {impl!r}: ring | ulysses")
+    if impl not in CP_IMPLS:
+        raise ValueError(f"unknown cp impl {impl!r}: {' | '.join(CP_IMPLS)}")
     n_ctx = mesh.shape[axis]
 
     def loss(params, tokens: jax.Array) -> jax.Array:

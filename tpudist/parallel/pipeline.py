@@ -24,10 +24,17 @@ and the loss masked off except at the ring's ends. The head matmul per
 slot is the price of the single-program design (~head/(layers/S) relative
 overhead); the layers dominate at depth, which is when PP is used at all.
 
-Composes with data/fsdp/tensor sharding: only ``pipe`` is manualized in
-the shard_map; batch and weight dims keep flowing through the SPMD
-partitioner. Context parallelism does not compose (ring attention manual-
-izes ``context`` in its own shard_map) — the engine rejects that pairing.
+Works for both layered sequence models: the dense transformer and the
+MoE (whose stages carry a router-aux accumulator, masked to slots where
+the stage holds a real microbatch — bubble-slot garbage must not leak
+into the load-balancing loss).
+
+Composes with data/fsdp/tensor/expert sharding as ZeRO-style STORAGE
+sharding: only ``pipe`` is manualized in the shard_map, and weight shards
+are gathered outside the manual region for compute (the constraint's
+transpose reduce-scatters the grads back). Context parallelism does not
+compose (ring attention manualizes ``context`` in its own shard_map) —
+the engine rejects that pairing.
 """
 
 from __future__ import annotations
@@ -52,8 +59,10 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
     ride data/fsdp outside the manual region). ``n_microbatches`` 0 means
     one microbatch per stage — the minimum that fills the pipeline.
     """
+    from tpudist.models import moe as MOE
     from tpudist.models import transformer as T
 
+    is_moe = cfg.name == "moe"
     n_stages = mesh.shape[axis]
     n_micro = n_microbatches or n_stages
     if cfg.n_layers % n_stages:
@@ -97,36 +106,55 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             layers_local = params["layers"]     # leading dim n_layers/S
 
             def run_stage(x):
-                def lbody(x, lp):
-                    return T._layer(x, lp, cfg, cos, sin,
-                                    T._attention), None
+                """One stage's layers; returns (x, summed router aux)."""
+                def lbody(carry, lp):
+                    x, a = carry
+                    if is_moe:
+                        x, la = MOE._moe_layer(x, lp, cfg, cos, sin,
+                                               T._attention)
+                        a = a + la
+                    else:
+                        x = T._layer(x, lp, cfg, cos, sin, T._attention)
+                    return (x, a), None
                 if remat:
                     lbody = jax.checkpoint(lbody)
-                x, _ = lax.scan(lbody, x, layers_local,
-                                unroll=cfg.n_layers // n_stages <= 8)
-                return x
+                (x, a), _ = lax.scan(lbody,
+                                     (x, jnp.zeros((), jnp.float32)),
+                                     layers_local,
+                                     unroll=cfg.n_layers // n_stages <= 8)
+                return x, a
 
             def slot(carry, t):
-                x, loss_sum = carry
+                x, loss_sum, aux_sum = carry
                 # ring ends, masked elsewhere: stage 0 ingests microbatch
                 # t; the last stage completes microbatch t-(S-1)
                 ingest = mb_x[jnp.clip(t, 0, n_micro - 1)]
                 x = jnp.where(stage == 0, ingest, x)
-                x = run_stage(x)
+                x, stage_aux = run_stage(x)
+                # this stage holds a REAL microbatch only for slots
+                # [stage, stage + M): bubble-slot aux is garbage
+                holds = (t >= stage) & (t < stage + n_micro)
+                aux_sum = aux_sum + jnp.where(holds, stage_aux, 0.0)
                 done = t - (n_stages - 1)
                 mb_l = T.head_loss(emb, T.rmsnorm(x, params["final_norm"]),
                                    mb_tgt[jnp.clip(done, 0, n_micro - 1)])
                 valid = (stage == n_stages - 1) & (done >= 0)
                 loss_sum = loss_sum + jnp.where(valid, mb_l, 0.0)
                 x = lax.ppermute(x, axis, perm)
-                return (x, loss_sum), None
+                return (x, loss_sum, aux_sum), None
 
             x0 = jnp.zeros((b // n_micro, s, cfg.d_model), dtype)
-            (_, loss_sum), _ = lax.scan(
-                slot, (x0, jnp.zeros((), jnp.float32)),
+            zero = jnp.zeros((), jnp.float32)
+            (_, loss_sum, aux_sum), _ = lax.scan(
+                slot, (x0, zero, zero),
                 jnp.arange(n_micro + n_stages - 1))
-            # only the last stage accumulated; psum replicates the scalar
-            return lax.psum(loss_sum, axis) / n_micro
+            # loss lives on the last stage; every stage contributed its
+            # layers' aux — one psum replicates/combines both
+            loss = lax.psum(loss_sum, axis) / n_micro
+            if is_moe:
+                loss = loss + cfg.router_aux_weight * lax.psum(
+                    aux_sum, axis) / (cfg.n_layers * n_micro)
+            return loss
 
         # prefix specs: every stacked layer leaf is stage-sharded on its
         # leading dim; embed/final_norm are replicated over pipe (the tied
