@@ -16,6 +16,11 @@ from dataclasses import dataclass
 from typing import Optional, Sequence
 
 
+# context-parallel attention implementations (single source of truth;
+# tpudist.models.transformer imports this for its validation/errors)
+CP_IMPLS = ("ring", "ulysses")
+
+
 @dataclass(frozen=True)
 class DataConfig:
     """Synthetic dataset shape (parity: reference ``train.py:19-24,63``)."""
@@ -149,7 +154,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--pp-microbatches", type=int, default=0,
                    help="pipeline microbatches per step (0 = pipe size)")
     p.add_argument("--cp-impl", type=str, default="ring",
-                   choices=["ring", "ulysses"],
+                   choices=list(CP_IMPLS),
                    help="context-parallel attention: kv ring rotation "
                         "(zigzag causal balance, scales past head count) "
                         "or ulysses all-to-all head resharding")

@@ -14,8 +14,12 @@ all-reduce is hidden inside the engine. Here the engine is a pytree
     reduce-scatters / psums (the scaling-book recipe); no hand-written
     collectives to get wrong.
 
-Both paths produce bitwise-identical math on the same mesh ordering; tests
-assert DP-vs-single-device and FSDP-vs-DP agreement.
+Context- and pipeline-parallel meshes build their loss through the
+models' shard_map-based builders (make_cp_loss_fn, parallel.pipeline)
+inside the general path. Both engine paths produce bitwise-identical math
+on the same mesh ordering for the dense models (the MoE's group-local
+routing is the documented exception, models/moe.py); tests assert
+DP-vs-single-device and FSDP-vs-DP agreement.
 """
 
 from __future__ import annotations

@@ -12,8 +12,13 @@ Sharding design (scaling-book recipe — annotate, let XLA insert collectives):
   * fsdp axis: every weight's first (non-tensor-sharded) dim is sharded;
     XLA all-gathers weights per layer and reduce-scatters grads.
   * context axis: sequence dim of activations; attention runs as ring
-    attention (tpudist.ops.ring_attention) when the axis is >1.
+    attention (tpudist.ops.ring_attention) or Ulysses all-to-all
+    (tpudist.ops.ulysses) when the axis is >1, per ``cp_impl``.
+  * pipe axis: leading dim of the stacked layer weights (GPipe stages,
+    tpudist.parallel.pipeline).
 
+On TPU, local attention and RoPE run fused in the pallas flash kernel
+(tpudist.ops.pallas.flash_attention); see ``_attention`` for the routing.
 Stacked-layer params use a leading ``n_layers`` dim and the forward uses
 ``lax.scan`` over layers — one compiled layer body regardless of depth
 (fast compiles, XLA-friendly).
@@ -28,7 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from tpudist.config import ModelConfig
+from tpudist.config import CP_IMPLS, ModelConfig
 
 Params = Dict
 
@@ -373,9 +378,6 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
     return head_loss(params["embed"].astype(dtype), h, targets,
                      xent_chunks=xent_chunks, fused_xent=fused_xent,
                      logits_sharding=logits_sharding)
-
-
-CP_IMPLS = ("ring", "ulysses")
 
 
 def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int):
