@@ -104,19 +104,24 @@ def test_train_step_smoke_on_chip():
     assert l1 < l0
 
 
-def test_flash_attention_compiled_matches_dense_on_chip():
+@pytest.mark.parametrize("kv", [8, 2])
+def test_flash_attention_compiled_matches_dense_on_chip(kv):
     """Mosaic-compiled flash attention vs the dense XLA path at the bench
-    head geometry (hd=128), bf16, causal — fwd and all three grads."""
+    head geometry (hd=128), bf16, causal — fwd and all three grads; kv=2
+    covers the grouped-query expansion + dk/dv group-sum on chip."""
     from tpudist.ops.pallas.flash_attention import flash_attention
 
     b, s, h, hd = 4, 512, 8, 128
     ks = jax.random.split(jax.random.PRNGKey(0), 4)
     q = jax.random.normal(ks[0], (b, s, h, hd), jnp.bfloat16)
-    k = jax.random.normal(ks[1], (b, s, h, hd), jnp.bfloat16)
-    v = jax.random.normal(ks[2], (b, s, h, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, kv, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kv, hd), jnp.bfloat16)
     ct = jax.random.normal(ks[3], (b, s, h, hd), jnp.bfloat16)
 
     def dense(q, k, v):
+        if kv != h:
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
         mask = jnp.tril(jnp.ones((s, s), bool))
         sc = jnp.where(mask, sc, -1e30)
