@@ -71,8 +71,15 @@ def test_grouped_routing_matches_single_group():
     cfg_1 = dataclasses.replace(MODEL, moe_group_size=0)
     assert moe.group_size(cfg_g, 128) == 32
     assert moe.group_size(cfg_1, 128) == 128
+    # non-divisor: largest divisor at or below wins (memory stays bounded)
     assert moe.group_size(dataclasses.replace(MODEL, moe_group_size=48),
-                          128) == 128  # non-divisor falls back
+                          128) == 32
+    assert moe.group_size(dataclasses.replace(MODEL, moe_group_size=100),
+                          96) == 96
+    # near-prime: tiny divisors would degenerate capacity/aux semantics —
+    # fall back to one global group instead
+    assert moe.group_size(dataclasses.replace(MODEL, moe_group_size=48),
+                          127) == 127
     params = moe.init(jax.random.PRNGKey(0), MODEL)
     toks = _tokens()
     l_g = moe.loss_fn(params, toks, cfg_g, dtype=jnp.float32)

@@ -56,11 +56,21 @@ def group_size(cfg: ModelConfig, n_tokens: int) -> int:
     """Tokens per routing group. Routing within fixed-size groups (the
     GShard recipe) keeps the (group, E, cap) dispatch tensors LINEAR in
     total tokens — one global group would make them quadratic, since
-    capacity itself scales with the routed token count. Token counts that
-    ``moe_group_size`` doesn't divide fall back to one global group
-    (fine at test scale, which is when that happens)."""
+    capacity itself scales with the routed token count. When
+    ``moe_group_size`` doesn't divide the token count, the largest
+    divisor at or below it is used instead (trace-time search) — unless
+    that divisor is under half the configured size (near-prime token
+    counts), where tiny groups would degenerate the capacity/aux math;
+    there one global group keeps the routing semantics correct at the
+    price of the quadratic dispatch tensor. <=0 disables grouping.
+    """
     g = cfg.moe_group_size
-    return g if 0 < g < n_tokens and n_tokens % g == 0 else n_tokens
+    if g <= 0 or g >= n_tokens:
+        return n_tokens
+    d = g
+    while n_tokens % d:
+        d -= 1
+    return d if 2 * d >= g else n_tokens
 
 
 def init(key: jax.Array, cfg: ModelConfig) -> Params:
