@@ -8,16 +8,14 @@ through an online softmax — only lower-triangle (qi >= kj) blocks are
 computed, the diagonal gets the intra-chunk causal mask, and nothing bigger
 than a (b, h, chunk, chunk) block ever exists.
 
-Why not the stock pallas flash attention: measured on v5e at seq 4096 it
-runs 414 ms/fwd (tuned blocks; 289 ms default) vs 16.6 ms for this
-decomposition at chunk 1024 — XLA's own fusion of the einsum + online
-softmax is an order of magnitude better here, and this version needs no
-Mosaic path, so the CPU test lane runs it bit-identically.
-
-Used automatically by ``tpudist.models.transformer._attention`` for causal
-sequences >= 2048 off-TPU (on TPU the pallas flash kernel takes those
-shapes; the context-parallel ring path has its own per-hop consume and
-does not call this).
+Role: the long-context path for everything that is not the pallas flash
+kernel — the CPU test lane (bit-identical, no Mosaic), shapes the kernel
+rejects (seq/head_dim alignment), and the TPUDIST_NO_FLASH escape. On TPU
+the flash kernel now wins at every long-context shape (v5e, b2·h16·hd128:
+seq 4096 fwd 3.1 ms flash vs 8.2 ms here, fwd+bwd 8.6 vs 20.3 ms) and is
+the default; an earlier environment's minutes-long Mosaic compile at seq
+4096 no longer reproduces (~5 s). The context-parallel ring path has its
+own per-hop consume and does not call this.
 """
 
 from __future__ import annotations

@@ -47,6 +47,18 @@ from tpudist.ops.gqa import expand_gqa
 
 NEG = -1e30
 
+# The kernels' working set (double-buffered q/k/v/out blocks + f32
+# accumulators) exceeds the 16 MiB default scoped-VMEM budget at the
+# default block sizes (measured 18 MB at block_b 8, blocks 512). Carrying
+# the limit on the pallas_call itself makes the kernels self-contained —
+# they compile whether or not the process set
+# --xla_tpu_scoped_vmem_limit_kib (tpudist.utils.tune_tpu); v5e VMEM is
+# 128 MiB total.
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary", "arbitrary"),
+    vmem_limit_bytes=100 * 1024 * 1024,
+)
+
 # dot_general dimension numbers for (nb, m, k) x (nb, n, k) -> (nb, m, n)
 _BMM_NT = (((2,), (2,)), ((0,), (0,)))
 # (nb, m, k) x (nb, k, n) -> (nb, m, n)
@@ -202,6 +214,7 @@ def _fwd(q, k, v, cos, sin, *, scale, block_b, block_q, block_k, causal,
             pltpu.VMEM((block_b, block_q, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(*args)
     return o, lse
 
@@ -337,6 +350,7 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
         out_shape=jax.ShapeDtypeStruct((bh, s, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_b, block_q, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(*args)
 
     # q innermost: the (nb, block_k, d) accumulators are revisited across
@@ -369,6 +383,7 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
             pltpu.VMEM((block_b, block_k, d), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(*args_t)
     dcos = None if cos is None else jnp.zeros_like(cos)
     dsin = None if sin is None else jnp.zeros_like(sin)
