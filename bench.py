@@ -1,20 +1,32 @@
 """Headline benchmark: synthetic transformer training throughput + MFU.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
-``vs_baseline`` is the ratio of this run's tokens/s/chip to the best value
-recorded by any prior round's ``BENCH_r*.json`` in the repo root (1.0 when
-none exists), so regressions are visible in the artifact itself. ``detail``
-carries an analytic MFU: FLOPs/token = 6·N_params + 6·L·d·s (dense matmuls
-fwd+bwd ≈ 6N, plus causal attention scores/values), against the chip's bf16
-peak. The workload is BASELINE.json config #5 shaped to one chip:
-Llama-style block stack (4 layers, 2048 hidden, bf16) full train step
-(fwd+bwd+Adam) under jit.
+Default mode prints ONE JSON line: {"metric", "value", "unit",
+"vs_baseline", "detail"}. ``vs_baseline`` is the ratio of this run's
+tokens/s/chip to the best value recorded by any prior round's
+``BENCH_r*.json`` in the repo root (1.0 when none exists), so regressions
+are visible in the artifact itself. ``detail`` carries an analytic MFU:
+FLOPs/token = 6·N_active + 6·L·d·s (dense matmuls fwd+bwd ≈ 6N, plus
+causal attention scores/values), against the chip's bf16 peak. N_active
+discounts non-routed expert weights for the MoE model (top_k/E of each
+expert FFN does useful work per token — the honest convention; the
+dispatch/combine einsums are framework overhead, not model FLOPs). The
+workload is BASELINE.json config #5 shaped to one chip: Llama-style block
+stack (4 layers, 2048 hidden, bf16) full train step (fwd+bwd+Adam) under
+jit.
+
+``--matrix`` instead benches the whole perf surface — {seq 512, 2048,
+4096} × {plain, fused, chunked LM head} × {flash, no-flash} × {dense,
+moe} (meaningful cells only; see ``matrix_rows``) — printing one JSONL
+line per cell and writing the committed artifact ``BENCH_MATRIX.json``
+plus a README-ready markdown table. One command, one artifact: the
+reference's everything-is-an-observable-output stance
+(reference slurm_train.sbatch:38,43) applied to performance claims.
 
 ``--fused-xent`` benches the pallas fused LM-head variant
-(tpudist.ops.pallas.fused_xent): slightly lower tokens/s at batch 24 (two
-extra logits-block matmuls in its recomputing backward) but it removes the
+(tpudist.ops.pallas.fused_xent): slower at the plain path's plateau batch
+(its backward recomputes logits blocks twice) but it removes the
 (tokens, vocab) logits tensor from HBM entirely — batch 96+ trains on one
-v5e, where the plain path OOMs at 48.
+v5e, where the plain path OOMs.
 """
 
 from __future__ import annotations
@@ -22,6 +34,7 @@ from __future__ import annotations
 import argparse
 import glob
 import json
+import os
 import re
 import statistics
 import time
@@ -29,8 +42,8 @@ import time
 import jax
 
 from tpudist import data, engine
-from tpudist.config import (DataConfig, ParallelConfig, TrainConfig,
-                            flagship_model_config)
+from tpudist.config import (DataConfig, ModelConfig, ParallelConfig,
+                            TrainConfig, flagship_model_config)
 
 # bf16 peak TFLOP/s by device kind (dense); None → MFU not reported
 PEAK_TFLOPS = [
@@ -48,19 +61,30 @@ def chip_peak_tflops(device_kind: str):
     return None
 
 
-def train_flops_per_token(n_params: int, cfg: TrainConfig) -> float:
+def active_params(params, cfg: TrainConfig) -> int:
+    """Parameters doing useful work per token: everything, minus the
+    (1 − top_k/E) fraction of each MoE expert weight a token never visits."""
+    total = sum(x.size for x in jax.tree.leaves(params))
+    m = cfg.model
+    if m.name != "moe":
+        return total
+    layers = params["layers"]
+    expert = sum(layers[k].size for k in ("w_gate", "w_up", "w_down"))
+    return total - int(expert * (1.0 - m.expert_top_k / m.n_experts))
+
+
+def train_flops_per_token(n_active: int, cfg: TrainConfig) -> float:
     """6·N for the dense matmuls (fwd 2N + bwd 4N) plus causal attention:
     per layer fwd = 2·(2·s·d)·0.5 (QKᵀ + PV, halved by causality), ×3 for
     fwd+bwd."""
     m = cfg.model
     s = m.max_seq_len
-    return 6.0 * n_params + 6.0 * m.n_layers * m.d_model * s
+    return 6.0 * n_active + 6.0 * m.n_layers * m.d_model * s
 
 
 def best_prior_bench() -> float | None:
     """Best tokens/s/chip across prior rounds' BENCH_r*.json, anchored to
     this script's directory (cwd-independent)."""
-    import os
     here = os.path.dirname(os.path.abspath(__file__))
     best = None
     for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
@@ -75,6 +99,206 @@ def best_prior_bench() -> float | None:
     return best
 
 
+def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
+              model: str = "transformer", remat: bool = False,
+              moe_group: int = 512) -> TrainConfig:
+    """One matrix cell's TrainConfig. ``head``: plain | fused | cN
+    (chunked over N sequence chunks)."""
+    n_dev = jax.device_count()
+    batch = per_chip * n_dev
+    if model == "moe":
+        # d_ff 2752 per expert: active params/token = attn side + top2/8 of
+        # the expert weights ≈ 267M — the same active size as the dense
+        # flagship, so the MoE row reads apples-to-apples. (Experts at the
+        # dense model's d_ff 5504 total 1.2B params, whose f32 Adam state
+        # alone exceeds one v5e's 16 GB HBM past batch 4 — that shape
+        # belongs to multi-chip expert parallelism, which the dryrun's
+        # expert-axis mesh exercises.) Group 512, batch 24/chip: measured
+        # optimum on v5e — 66.9k tok/s, 55.5% active-MFU; group 2048 drops
+        # to 60.0k (dispatch/combine einsum FLOPs scale linearly with
+        # group size), batch 32 to 61.7k.
+        mcfg = ModelConfig(name="moe", vocab_size=32000, n_layers=4,
+                           d_model=2048, n_heads=16, n_kv_heads=16,
+                           d_ff=2752, max_seq_len=seq, n_experts=8,
+                           expert_top_k=2, moe_group_size=moe_group)
+    else:
+        mcfg = flagship_model_config(max_seq_len=seq)
+    return TrainConfig(
+        batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
+        fused_xent=(head == "fused"), remat=remat,
+        xent_chunks=(int(head[1:]) if head.startswith("c") else 0),
+        data=DataConfig(n_samples=batch),
+        model=mcfg,
+        parallel=ParallelConfig(data=-1))
+
+
+def measure(cfg: TrainConfig, iters: int = 60) -> dict:
+    """Steady-state step time of cfg's train step on the live mesh.
+
+    Timing in groups: per-group fencing (a host transfer — on tunneled
+    PJRT backends block_until_ready can return before execution completes)
+    keeps the async queue honest, and the 20-step group amortises the
+    fence's pipeline drain (~100 ms tunneled; a 5-step group inflates step
+    time ~8%)."""
+    from tpudist.parallel import build_mesh
+    from tpudist.parallel import sharding as shd
+    mesh = build_mesh(cfg.parallel)
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    n_active = active_params(state.params, cfg)
+    n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    step = engine.make_train_step(cfg, mesh)
+    seq = cfg.model.max_seq_len
+    toks = data.make_synthetic_tokens(cfg.batch_size, seq + 1,
+                                      cfg.model.vocab_size, seed=0)
+    # place the batch once: steady-state training streams input during the
+    # previous step, so per-step host transfer must not pollute the timing
+    batch_t = shd.put_batch(mesh, (toks,))
+
+    for _ in range(2):                       # trace + compile + warm
+        state, loss = step(state, batch_t)
+    float(loss)
+
+    group, n_groups = 20, max(2, iters // 20)
+    group_ms = []
+    for _ in range(n_groups):
+        t0 = time.perf_counter()
+        for _ in range(group):
+            state, loss = step(state, batch_t)
+        float(loss)
+        group_ms.append((time.perf_counter() - t0) * 1000 / group)
+
+    n_dev = jax.device_count()
+    step_ms = statistics.median(group_ms)
+    tok_s_chip = cfg.batch_size * seq / (step_ms / 1000) / n_dev
+    device_kind = jax.devices()[0].device_kind
+    peak = chip_peak_tflops(device_kind)
+    achieved = train_flops_per_token(n_active, cfg) * tok_s_chip / 1e12
+    return {
+        "device": device_kind,
+        "n_devices": n_dev,
+        "global_batch": cfg.batch_size,
+        "seq_len": seq,
+        "n_params": n_params,
+        "n_active_params": n_active,
+        "tok_s_chip": round(tok_s_chip, 1),
+        "mfu_pct": round(100 * achieved / peak, 2) if peak else None,
+        "achieved_tflops_per_chip": round(achieved, 1),
+        "peak_tflops": peak,
+        "step_time_ms": round(step_ms, 2),
+        "step_time_ms_min": round(min(group_ms), 2),
+        "step_time_ms_max": round(max(group_ms), 2),
+    }
+
+
+# ------------------------------------------------------------------ matrix
+
+# (model, seq, head, flash, per_chip[, remat]) — meaningful cells only:
+#   * per-chip batch keeps tokens/step ≈ 28k as seq grows (the measured
+#     plain-path plateau), 96 for the fused head (its reason to exist),
+#     24 for no-flash at 512 (dense scores OOM above).
+#   * chunked head (c4) rows cover the remaining LM-head strategy.
+#   * no-flash rows measure the XLA fallback (dense at 512, blockwise at
+#     2048/4096) — the CPU-test reference path's on-chip cost.
+#   * one MoE row (8 experts, top-2, same backbone) at the dense plateau
+#     batch; group size pre-tuned via --moe-group.
+MATRIX_ROWS = [
+    ("transformer", 512, "plain", True, 56, False),
+    ("transformer", 512, "fused", True, 96, True),
+    ("transformer", 512, "c4", True, 56, False),
+    ("transformer", 512, "plain", False, 24, False),
+    ("transformer", 2048, "plain", True, 12, False),
+    ("transformer", 2048, "c4", True, 12, False),
+    ("transformer", 2048, "plain", False, 12, False),
+    ("transformer", 4096, "plain", True, 6, False),
+    ("transformer", 4096, "c4", True, 6, False),
+    ("transformer", 4096, "plain", False, 6, False),
+    ("moe", 512, "plain", True, 24, False),
+    ("moe", 512, "fused", True, 24, True),
+]
+
+
+def run_cell(spec: str, iters: int, moe_group: int) -> None:
+    """One matrix cell (subprocess entry): prints exactly one JSON line."""
+    model, seq, head, flash, per_chip, remat = spec.split(":")
+    seq, per_chip = int(seq), int(per_chip)
+    flash, remat = flash == "1", remat == "1"
+    label = (f"{model}/seq{seq}/{head}/"
+             f"{'flash' if flash else 'noflash'}/b{per_chip}")
+    base = {"config": label, "model": model, "seq": seq, "lm_head": head,
+            "flash": flash, "remat": remat}
+    try:
+        cfg = build_cfg(seq=seq, per_chip=per_chip, head=head,
+                        model=model, remat=remat, moe_group=moe_group)
+        rec = {**base, **measure(cfg, iters=iters)}
+    except Exception as e:   # OOM/compile failure is a result, not a crash
+        rec = {**base, "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    print("MATRIX_CELL " + json.dumps(rec), flush=True)
+
+
+def run_matrix(iters: int, out_path: str, moe_group: int) -> dict:
+    """Each cell runs in a fresh subprocess: (a) a cell's OOM/compile crash
+    cannot kill the sweep, and (b) env that must differ per cell
+    (TPUDIST_NO_FLASH; the scoped-VMEM workaround below) is snapshotted at
+    first PJRT use, so it cannot be changed within one process."""
+    import subprocess
+    import sys
+    here = os.path.abspath(__file__)
+    rows = []
+    for model, seq, head, flash, per_chip, remat in MATRIX_ROWS:
+        spec = (f"{model}:{seq}:{head}:{int(flash)}:{per_chip}:{int(remat)}")
+        env = dict(os.environ)
+        if flash:
+            # an inherited escape-hatch var would silently bench the XLA
+            # fallback under a "flash" label in the committed artifact
+            env.pop("TPUDIST_NO_FLASH", None)
+        else:
+            env["TPUDIST_NO_FLASH"] = "1"
+        rec = None
+        try:
+            r = subprocess.run(
+                [sys.executable, here, "--cell", spec, "--iters",
+                 str(iters), "--moe-group", str(moe_group)],
+                env=env, capture_output=True, text=True, timeout=3000)
+            for ln in r.stdout.splitlines():
+                if ln.startswith("MATRIX_CELL "):
+                    rec = json.loads(ln[len("MATRIX_CELL "):])
+            tail = f"rc={r.returncode}: {(r.stderr or r.stdout)[-200:]}"
+        except subprocess.TimeoutExpired:
+            # a wedged cell must not lose the rows already measured
+            tail = "timeout after 3000s"
+        if rec is None:
+            rec = {"config": spec, "model": model, "seq": seq,
+                   "lm_head": head, "flash": flash, "remat": remat,
+                   "error": f"cell subprocess {tail}"}
+        print(json.dumps(rec), flush=True)
+        rows.append(rec)
+    art = {"matrix_version": 1, "rows": rows}
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(markdown_table(rows))
+    return art
+
+
+def markdown_table(rows) -> str:
+    """README-ready table, regenerated from the artifact (single source)."""
+    lines = ["| model | seq | LM head | attention | batch/chip | tok/s/chip "
+             "| MFU % | step ms |",
+             "|---|---|---|---|---|---|---|---|"]
+    for r in rows:
+        att = "flash" if r.get("flash") else "XLA fallback"
+        if "error" in r:
+            # raw error text contains newlines/'|' that break the table
+            err = " ".join(r["error"].split()).replace("|", "/")[:40]
+            lines.append(f"| {r['model']} | {r['seq']} | {r['lm_head']} | "
+                         f"{att} | — | — | — | {err} |")
+            continue
+        lines.append(
+            f"| {r['model']} | {r['seq']} | {r['lm_head']} | {att} | "
+            f"{r['global_batch'] // r['n_devices']} | {r['tok_s_chip']:,} | "
+            f"{r['mfu_pct']} | {r['step_time_ms']} |")
+    return "\n".join(lines)
+
+
 def main() -> None:
     from tpudist.utils import maybe_force_platform, tune_tpu
     maybe_force_platform()
@@ -85,90 +309,59 @@ def main() -> None:
                    help="bench the pallas fused LM-head variant")
     p.add_argument("--batch-per-chip", type=int, default=None)
     p.add_argument("--iters", type=int, default=60)
+    p.add_argument("--matrix", action="store_true",
+                   help="bench the full perf surface; write BENCH_MATRIX.json")
+    p.add_argument("--cell", type=str, default=None,
+                   help="internal: run one matrix cell "
+                        "(model:seq:head:flash:per_chip:remat)")
+    p.add_argument("--matrix-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_MATRIX.json"))
+    p.add_argument("--moe-group", type=int, default=512,
+                   help="MoE routing group size for the matrix's moe rows "
+                        "(dispatch einsum FLOPs scale linearly with it)")
     args = p.parse_args()
 
-    n_dev = jax.device_count()
-    seq = 512
-    # 48/chip: measured plateau on v5e for the plain path with the pallas
-    # flash-attention kernel (24→83.9k, 32→86.0k, 48→87.1k, 64→83.5k
-    # tok/s/chip; without flash the score tensors OOM this batch). The
-    # fused head removes the logits tensor from HBM so it runs big-batch;
-    # pairing it with remat keeps the backbone activations within HBM at
-    # batch 96.
-    # with TPUDIST_NO_FLASH the dense score tensors cap the plain path at
-    # its old batch-24 plateau (48 OOMs)
-    import os
+    if args.cell:
+        run_cell(args.cell, args.iters, args.moe_group)
+        return
+    if args.matrix:
+        run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)
+        return
+
+    # 56/chip: measured plateau on v5e for the plain path with the
+    # round-3 kernels (single-block flash specialisation, merged dq/dk/dv
+    # backward, custom xent VJP): 40→93.5k, 48→95.4k, 52→95.9k, 56→96.2k,
+    # 60→94.7k, 64→91.5k tok/s/chip. Beyond 56 XLA's rematerialisation
+    # (driven by the f32 logits pair the plain head materialises) grows
+    # faster than the batch amortisation — measured 31 ms/step of .remat
+    # fusions at 56, and every explicit alternative (chunked head, fused
+    # kernel, whole-layer remat) benched slower. The fused head removes
+    # the logits tensor from HBM so it runs big-batch; pairing it with
+    # remat keeps the backbone activations within HBM at batch 96.
+    # with TPUDIST_NO_FLASH the dense-attention path peaks ~85k (48/chip).
     no_flash = bool(os.environ.get("TPUDIST_NO_FLASH"))
     per_chip = args.batch_per_chip or (
-        96 if args.fused_xent else (24 if no_flash else 48))
-    batch = per_chip * n_dev
-    cfg = TrainConfig(
-        batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
-        fused_xent=args.fused_xent, remat=args.fused_xent,
-        data=DataConfig(n_samples=batch),
-        model=flagship_model_config(max_seq_len=seq),
-        parallel=ParallelConfig(data=-1))
-
-    from tpudist.parallel import build_mesh
-    from tpudist.parallel import sharding as shd
-    mesh = build_mesh(cfg.parallel)
-    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
-    n_params = sum(x.size for x in jax.tree.leaves(state.params))
-    step = engine.make_train_step(cfg, mesh)
-    toks = data.make_synthetic_tokens(batch, seq + 1, cfg.model.vocab_size,
-                                      seed=0)
-    # place the batch once: steady-state training streams input during the
-    # previous step, so per-step host transfer must not pollute the timing
-    batch_t = shd.put_batch(mesh, (toks,))
-
-    # warmup: trace + compile + first execution (fence via host transfer —
-    # on tunneled/remote PJRT backends block_until_ready can return before
-    # execution completes, inflating throughput ~100x)
-    for _ in range(2):
-        state, loss = step(state, batch_t)
-    float(loss)
-
-    # timing in groups: per-group fencing keeps the async queue honest, and
-    # the 20-step group amortises the fence's pipeline drain (~100ms on the
-    # tunneled backend — a 5-step group inflates step time ~8%)
-    group, n_groups = 20, max(2, args.iters // 20)
-    group_ms = []
-    for _ in range(n_groups):
-        t0 = time.perf_counter()
-        for _ in range(group):
-            state, loss = step(state, batch_t)
-        float(loss)
-        group_ms.append((time.perf_counter() - t0) * 1000 / group)
-
-    step_ms = statistics.median(group_ms)
-    toks_per_step = batch * seq
-    tok_s_chip = toks_per_step / (step_ms / 1000) / n_dev
-
-    device_kind = jax.devices()[0].device_kind
-    peak = chip_peak_tflops(device_kind)
-    achieved_tflops = (train_flops_per_token(n_params, cfg) * tok_s_chip
-                       / 1e12)
-    mfu_pct = round(100 * achieved_tflops / peak, 2) if peak else None
+        96 if args.fused_xent else (24 if no_flash else 56))
+    cfg = build_cfg(seq=512, per_chip=per_chip,
+                    head="fused" if args.fused_xent else "plain",
+                    remat=args.fused_xent)
+    m = measure(cfg, iters=args.iters)
 
     prior = best_prior_bench()
+    tok_s_chip = m["tok_s_chip"]
     print(json.dumps({
         "metric": "transformer_train_tokens_per_sec_per_chip",
-        "value": round(tok_s_chip, 1),
+        "value": tok_s_chip,
         "unit": "tokens/s/chip",
         "vs_baseline": round(tok_s_chip / prior, 4) if prior else 1.0,
         "detail": {
-            "device": device_kind,
-            "n_devices": n_dev,
-            "global_batch": batch, "seq_len": seq,
+            **{k: m[k] for k in (
+                "device", "n_devices", "global_batch", "seq_len", "n_params",
+                "mfu_pct", "achieved_tflops_per_chip", "peak_tflops",
+                "step_time_ms", "step_time_ms_min", "step_time_ms_max")},
             "lm_head": "fused_xent" if args.fused_xent else "plain",
-            "n_params": n_params,
-            "mfu_pct": mfu_pct,
-            "achieved_tflops_per_chip": round(achieved_tflops, 1),
-            "peak_tflops": peak,
-            "steps_per_sec_per_chip": round(1000 / step_ms / n_dev, 4),
-            "step_time_ms": round(step_ms, 2),
-            "step_time_ms_min": round(min(group_ms), 2),
-            "step_time_ms_max": round(max(group_ms), 2),
+            "steps_per_sec_per_chip": round(
+                1000 / m["step_time_ms"] / m["n_devices"], 4),
             "prior_best_tok_s_chip": prior,
         },
     }))

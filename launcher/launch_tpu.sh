@@ -29,11 +29,15 @@
 #   TIMEOUT_S           provisioning+run timeout (default 1800)
 #   RUN_SWEEP=1         run the gated bandwidth sweep after training
 #   SWEEP_MIN_PCT       sweep gate threshold (default 90, BASELINE.md)
+#   SWEEP_PEAK_GBPS     operator override for the ICI ring peak (GB/s) —
+#                       required to gate a chip kind the built-in table
+#                       doesn't know (passed as --peak-gbps)
 #   GCS_SWEEP_VERDICT   verdict URI for the sweep gate
 #                       (default ${GCS_VERDICT}.sweep)
 #
 # Exit codes: 0 ok; 1 workload/probe failure; 2 workload ok but sweep gate
-# failed; 124 provisioning timeout.
+# failed; 3 sweep ungateable (unknown chip peak, no SWEEP_PEAK_GBPS);
+# 124 provisioning timeout.
 
 set -euo pipefail
 
@@ -174,13 +178,24 @@ if [ "${RUN_SWEEP:-0}" = "1" ]; then
   # code is the signal and THIS wrapper publishes the sweep verdict (the
   # container image carries no gsutil — same division of labor as the
   # main verdict). timeout: a wedged collective must not eat the slice.
+  SWEEP_PEAK_ARG=""
+  [ -n "${SWEEP_PEAK_GBPS:-}" ] && SWEEP_PEAK_ARG="--peak-gbps $SWEEP_PEAK_GBPS"
   tpu_ssh all "timeout 900 $RUN_PREFIX python3 -m tpudist.bench.sweep \
-    --kinds all_reduce --min-pct-peak $SWEEP_MIN_PCT \
+    --kinds all_reduce --min-pct-peak $SWEEP_MIN_PCT $SWEEP_PEAK_ARG \
     --out /tmp/sweep.jsonl"
   SWEEP_RC=$?
   gcloud compute tpus tpu-vm scp "$TPU_NAME:/tmp/sweep.jsonl" sweep.jsonl \
     --zone "$ZONE" --project "$PROJECT" --worker=0 || true
   set -e
+  if [ $SWEEP_RC -eq 3 ]; then
+    # sweep rc 3 = ungateable: unknown chip peak and no SWEEP_PEAK_GBPS
+    # override — absolute GB/s is in sweep.jsonl, but there was nothing to
+    # gate against. Distinct verdict + exit code so CI can tell "first run
+    # on a new chip generation" from a real bandwidth failure.
+    echo "⚠️ bandwidth sweep ungateable (unknown chip peak; set --peak-gbps)"
+    echo -n ungateable | gsutil cp - "$GCS_SWEEP_VERDICT" || true
+    exit 3
+  fi
   if [ $SWEEP_RC -ne 0 ]; then
     echo "❌ bandwidth sweep below ${SWEEP_MIN_PCT}% of ring peak (rc=$SWEEP_RC)"
     echo -n fail | gsutil cp - "$GCS_SWEEP_VERDICT" || true

@@ -111,3 +111,77 @@ def test_pipe_and_expert_sharded_roundtrip(tmp_path, model_kw, par,
     _, l1a = step(restored, (toks,))
     _, l1b = step(state, (toks,))
     np.testing.assert_allclose(float(l1a), float(l1b), rtol=1e-6)
+
+
+def test_checkpointer_async_roundtrip(tmp_path, cfg, devices8):
+    """Async saves land a readable step-keyed checkpoint with its resume
+    position, and close() drains the outstanding write."""
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = _state(cfg, mesh)
+    ck = checkpoint.Checkpointer(str(tmp_path), use_async=True)
+    ck.save(state, epoch=2, step_in_epoch=5)
+    ck.close()
+    restored, epoch, sie = checkpoint.restore_latest_full(
+        str(tmp_path), state)
+    assert (epoch, sie) == (2, 5)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state, restored)
+
+
+def test_restore_full_reads_legacy_epoch_layout(tmp_path, cfg, devices8):
+    """A save_dir written by the old epoch-keyed API must stay resumable:
+    restore_latest_full falls back to the bare-StandardSave layout and
+    reports (epoch+1, 0) as the resume position."""
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = _state(cfg, mesh)
+    checkpoint.save(str(tmp_path), state, epoch=3)
+    restored, epoch, sie = checkpoint.restore_latest_full(
+        str(tmp_path), state)
+    assert (epoch, sie) == (4, 0)
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state, restored)
+
+
+def _final_params(save_dir, cfg, mesh):
+    template = _state(cfg, mesh)
+    restored, _, _ = checkpoint.restore_latest_full(str(save_dir), template)
+    return restored
+
+
+def test_midepoch_resume_reproduces_trajectory(tmp_path, devices8,
+                                               monkeypatch):
+    """The preemption drill: kill training mid-epoch (keep only a
+    step-granular checkpoint), resume, and the final params must equal the
+    uninterrupted run's bit-for-bit (the epoch batch order is stateless by
+    (seed, epoch), so skipping the consumed prefix replays the exact
+    trajectory)."""
+    import shutil
+    from tpudist import train as train_lib
+
+    def mk(save_dir, **kw):
+        return TrainConfig(batch_size=8, epochs=1, lr=1e-2, seed=3,
+                           save_dir=str(save_dir), log_every=0,
+                           data=DataConfig(n_samples=64),  # 8 steps/epoch
+                           **kw)
+
+    # A: uninterrupted
+    train_lib.run(mk(tmp_path / "a"))
+    # B: checkpoint every 3 steps (mid-epoch saves at batch 3 and 6),
+    # then simulate the preemption by deleting everything after step 6
+    train_lib.run(mk(tmp_path / "b", ckpt_every_steps=3))
+    steps = sorted(int(p.name) for p in (tmp_path / "b").iterdir()
+                   if p.name.isdigit())
+    assert 6 in steps, f"expected a mid-epoch save at step 6, got {steps}"
+    for s in steps:
+        if s > 6:
+            shutil.rmtree(tmp_path / "b" / str(s))
+    # C: resume — must restart at epoch 0, batch 6 and finish the epoch
+    train_lib.run(mk(tmp_path / "b", resume=True))
+
+    cfg = mk(tmp_path / "a")
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    pa = _final_params(tmp_path / "a", cfg, mesh)
+    pb = _final_params(tmp_path / "b", cfg, mesh)
+    assert int(pa.step) == int(pb.step) == 8
+    jax.tree.map(lambda x, y: np.testing.assert_array_equal(
+        np.asarray(x), np.asarray(y)), pa.params, pb.params)

@@ -92,16 +92,16 @@ def test_sweep_gate_logic():
 
 
 def test_sweep_cli_gate_and_out(tmp_path):
-    """CPU mesh has no known ring peak -> gate not applicable -> exit 1
-    (absent evidence is a failure, like the reference's missing status
-    file); --min-pct-peak 0 disables the gate -> exit 0 and a clean JSONL
-    artifact."""
+    """CPU mesh has no known ring peak and no override -> gate not
+    applicable -> exit 3 + 'ungateable' verdict (distinct from a real
+    bandwidth failure, still not a success); --min-pct-peak 0 disables the
+    gate -> exit 0 and a clean JSONL artifact."""
     import json
     from tpudist.bench import sweep
     out = tmp_path / "sweep.jsonl"
     rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
                      "--out", str(out)])
-    assert rc == 1
+    assert rc == 3
     rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
                      "--min-pct-peak", "0", "--out", str(out)])
     assert rc == 0
@@ -110,10 +110,36 @@ def test_sweep_cli_gate_and_out(tmp_path):
                          for ln in lines)
 
 
-def test_sweep_verdict_file(tmp_path):
+def test_sweep_verdict_file_ungateable(tmp_path):
+    """Unknown chip + no override: the verdict file says 'ungateable',
+    never 'fail' (an operator must be able to tell a new chip generation
+    from a bandwidth regression) and never 'success' (absent evidence)."""
     from tpudist.bench import sweep
     v = tmp_path / "sweep_status.txt"
     rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--verdict-path", str(v)])
+    assert rc == 3
+    assert v.read_text() == "ungateable"
+
+
+def test_sweep_peak_override_gates(tmp_path):
+    """--peak-gbps makes an unknown chip gateable: a tiny threshold passes
+    (exit 0, 'success'), an impossible one fails (exit 1, 'fail')."""
+    import json
+    from tpudist.bench import sweep
+    v = tmp_path / "sweep_status.txt"
+    out = tmp_path / "sweep.jsonl"
+    rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--peak-gbps", "100", "--min-pct-peak", "1e-9",
+                     "--verdict-path", str(v), "--out", str(out)])
+    assert rc == 0
+    assert v.read_text() == "success"
+    # pct is now computed against the override
+    rec = json.loads(out.read_text().strip().splitlines()[0])
+    assert rec["pct_of_ring_peak"] == pytest.approx(
+        100 * rec["bus_gbps"] / 100.0)
+    rc = sweep.main(["--min-mb", "0.25", "--max-mb", "0.25", "--iters", "2",
+                     "--peak-gbps", "1e12", "--min-pct-peak", "90",
                      "--verdict-path", str(v)])
     assert rc == 1
     assert v.read_text() == "fail"

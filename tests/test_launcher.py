@@ -174,6 +174,29 @@ def test_sweep_gate_success_writes_sweep_verdict(stub_env):
     assert verdict(stub, "job_status.txt.sweep") == "success"
 
 
+def test_sweep_ungateable_exits_3_distinct_verdict(stub_env):
+    """Sweep rc 3 (unknown chip peak, no override): exit 3 and an
+    'ungateable' sweep verdict — distinguishable from both a pass and a
+    real bandwidth failure."""
+    env, stub = stub_env
+    env["RUN_SWEEP"] = "1"
+    env["STUB_SWEEP_RC"] = "3"
+    r = launch(env)
+    assert r.returncode == 3
+    assert verdict(stub) == "success"                  # training DID pass
+    assert verdict(stub, "job_status.txt.sweep") == "ungateable"
+
+
+def test_sweep_peak_override_forwarded(stub_env):
+    """SWEEP_PEAK_GBPS reaches the sweep command line as --peak-gbps."""
+    env, stub = stub_env
+    env["RUN_SWEEP"] = "1"
+    env["SWEEP_PEAK_GBPS"] = "123.5"
+    r = launch(env)
+    assert r.returncode == 0
+    assert "--peak-gbps 123.5" in (stub / "calls.log").read_text()
+
+
 def test_bare_path_installs_package_on_workers(stub_env):
     env, stub = stub_env
     r = launch(env)

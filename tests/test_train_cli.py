@@ -35,8 +35,10 @@ def test_happy_path_contract(tmp_path, capsys, monkeypatch):
     import re
     losses = [float(m) for m in re.findall(r"Avg loss: ([0-9.]+)", out)]
     assert losses[1] < losses[0]
-    # checkpoints + metrics written
-    assert (tmp_path / "ck" / "0").is_dir()
+    # checkpoints (step-keyed: one per epoch end) + metrics written
+    ck_steps = sorted(int(p.name) for p in (tmp_path / "ck").iterdir()
+                      if p.name.isdigit())
+    assert len(ck_steps) == 2 and ck_steps[-1] > 0
     assert (tmp_path / "ck" / "metrics.jsonl").is_file()
 
 
@@ -57,7 +59,7 @@ def test_resume_continues(tmp_path, capsys, monkeypatch):
     rc, out2 = _run(capsys, ["--epochs", "4", "--resume",
                              "--save-dir", save])
     assert rc == 0
-    assert "Resumed from epoch 1" in out2
+    assert "Resumed at epoch 2, step 0" in out2
     assert "Epoch  3 finished" in out2 and "Epoch  1 finished" not in out2
 
 

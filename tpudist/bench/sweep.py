@@ -65,13 +65,15 @@ def sweep_sizes(min_mb: float = 1, max_mb: float = 1024) -> List[int]:
 
 
 def run_sweep(kinds=("all_reduce",), axis: str = "data", *,
-              min_mb: float = 1, max_mb: float = 1024, iters: int = 10
-              ) -> List[dict]:
+              min_mb: float = 1, max_mb: float = 1024, iters: int = 10,
+              peak_gbps: Optional[float] = None) -> List[dict]:
     """Returns one record per (kind, size): message size, time, algo/bus
-    GB/s, % of ring peak (None off-TPU or unknown chip)."""
+    GB/s, % of ring peak (None off-TPU or unknown chip). ``peak_gbps``
+    overrides the built-in chip table — the operator escape hatch for a
+    chip generation RING_PEAK_GBPS doesn't know yet."""
     mesh = build_mesh(ParallelConfig())
     n = mesh.shape[axis]
-    peak = ring_peak_gbps()
+    peak = peak_gbps or ring_peak_gbps()
     out = []
     for kind in kinds:
         for size in sweep_sizes(min_mb, max_mb):
@@ -135,6 +137,11 @@ def main(argv=None) -> int:
                    help="acceptance threshold: best bucket per kind must "
                         "reach this %% of the ICI ring peak (BASELINE.md); "
                         "<=0 disables the gate")
+    p.add_argument("--peak-gbps", type=float, default=None,
+                   help="operator override for the ICI ring peak (GB/s) — "
+                        "gates against this instead of the built-in chip "
+                        "table; required to gate on a chip kind the table "
+                        "doesn't know")
     p.add_argument("--verdict-path", type=str, default=None,
                    help="write success/fail here (local path or gs://) — "
                         "the reference's job_status.txt protocol")
@@ -144,7 +151,7 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     records = run_sweep(tuple(args.kinds.split(",")), args.axis,
                         min_mb=args.min_mb, max_mb=args.max_mb,
-                        iters=args.iters)
+                        iters=args.iters, peak_gbps=args.peak_gbps)
     if args.out and jax.process_index() == 0:
         with open(args.out, "w") as f:
             for r in records:
@@ -154,10 +161,19 @@ def main(argv=None) -> int:
         return 0
     g = gate(records, args.min_pct_peak)
     log0(json.dumps({"sweep_gate": g}))
+    from tpudist import verdict
+    if g["ok"] is None:
+        # Nothing could be compared against a peak (unknown chip kind with
+        # no --peak-gbps override, or a single-device mesh). Absolute GB/s
+        # was still measured and recorded; publish the distinct UNGATEABLE
+        # status (exit 3) so the first run on a new TPU generation doesn't
+        # read as a bandwidth regression — a real below-threshold result
+        # stays a hard fail. Still nonzero: absent evidence must not
+        # publish success (the reference's missing-status-file stance).
+        if args.verdict_path:
+            verdict.write_final_status(args.verdict_path, verdict.UNGATEABLE)
+        return 3
     if args.verdict_path:
-        from tpudist import verdict
-        # None (couldn't measure) must not publish success: absent evidence
-        # maps to fail, like the reference's missing-status-file branch
         verdict.write_final_verdict(args.verdict_path, g["ok"] is True)
     return 0 if g["ok"] is True else 1
 

@@ -2,15 +2,31 @@
 
 Reference counterpart: per-epoch ``model_engine.save_checkpoint(save_dir/
 epochN)`` (reference ``train.py:123-125``) — write-only, no load path, no
-retention (SURVEY.md §5.4). Here: orbax ``CheckpointManager`` keyed by epoch,
-sharding-aware (saves/restores FSDP-sharded state without gathering),
-multi-host coordinated, with resume (``restore_latest``) and a retention
-policy — the cheap wins the reference skipped.
+retention (SURVEY.md §5.4). Here: orbax ``CheckpointManager`` keyed by the
+GLOBAL STEP, sharding-aware (saves/restores FSDP-sharded state without
+gathering), multi-host coordinated, with resume, a retention policy, and
+two TPU-preemptibility upgrades the per-epoch reference model can't
+express:
+
+  * **async saves** (default): ``save()`` blocks only for the
+    device→host snapshot; the disk/GCS write overlaps the following train
+    steps (orbax's AsyncCheckpointer) — an epoch no longer stalls for the
+    full serialisation. Donation-safe: the snapshot completes before
+    ``save()`` returns, so the next step may reuse the donated buffers.
+  * **step-granular saves** (``Checkpointer.save(..., step_in_epoch=k)``
+    + ``--ckpt-every-steps``): a queued-resources preemption mid-epoch
+    loses at most N steps, not the whole epoch. The (epoch,
+    step_in_epoch) resume position rides along as JSON metadata.
+
+The module-level ``save``/``restore_latest`` keep the original simple
+epoch-keyed synchronous semantics (used by tests and ad-hoc tooling); the
+train loop uses :class:`Checkpointer`.
 """
 
 from __future__ import annotations
 
 import os
+import time
 from typing import Any, Optional, Tuple
 
 import jax
@@ -19,19 +35,91 @@ import orbax.checkpoint as ocp
 DEFAULT_KEEP = 3
 
 
-def _manager(save_dir: str, keep: Optional[int] = DEFAULT_KEEP
-             ) -> ocp.CheckpointManager:
+def _manager(save_dir: str, keep: Optional[int] = DEFAULT_KEEP,
+             use_async: bool = False) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
         os.path.abspath(os.path.expanduser(save_dir)),
         options=ocp.CheckpointManagerOptions(
-            max_to_keep=keep, create=True, enable_async_checkpointing=False))
+            max_to_keep=keep, create=True,
+            enable_async_checkpointing=use_async))
+
+
+class Checkpointer:
+    """Step-keyed checkpoint manager for the train loop.
+
+    One instance lives across the whole run (creating a manager per save —
+    the old shape of this module — re-pays directory scans and defeats
+    async). ``save`` returns immediately after the device→host snapshot;
+    ``wait``/``close`` drain outstanding writes (call ``close`` before
+    reading the checkpoint back or ending the process).
+    """
+
+    def __init__(self, save_dir: str, *, keep: Optional[int] = DEFAULT_KEEP,
+                 use_async: bool = True):
+        self._mgr = _manager(save_dir, keep, use_async=use_async)
+        self.last_save_ms: float = 0.0
+
+    def save(self, state: Any, *, epoch: int, step_in_epoch: int = 0
+             ) -> None:
+        """Snapshot ``state`` keyed by its global step.
+
+        ``(epoch, step_in_epoch)`` is the RESUME POSITION: the epoch and
+        batch index training should continue from — an epoch-end save
+        passes ``epoch=finished+1, step_in_epoch=0``. All processes call
+        this (orbax coordinates the multi-host write — the analogue of
+        every rank calling save_checkpoint at reference train.py:125,
+        minus the redundant copies).
+        """
+        t0 = time.perf_counter()
+        self._mgr.save(int(state.step), args=ocp.args.Composite(
+            state=ocp.args.StandardSave(state),
+            meta=ocp.args.JsonSave({"epoch": int(epoch),
+                                    "step_in_epoch": int(step_in_epoch)})))
+        self.last_save_ms = (time.perf_counter() - t0) * 1000
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.close()
+
+
+def restore_latest_full(save_dir: str, template: Any
+                        ) -> Optional[Tuple[Any, int, int]]:
+    """Restore the newest step-keyed checkpoint as (state, epoch,
+    step_in_epoch) — the resume position saved alongside it — or None if
+    the directory holds none. ``template`` (a concretely-sharded
+    TrainState) pins shardings/dtypes so restoration lands directly in the
+    FSDP layout."""
+    path = os.path.abspath(os.path.expanduser(save_dir))
+    if not os.path.isdir(path):
+        return None
+    mgr = _manager(save_dir, None)
+    step = mgr.latest_step()
+    if step is None:
+        mgr.close()
+        return None
+    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
+    if not os.path.isdir(os.path.join(path, str(step), "meta")):
+        # legacy epoch-keyed layout (bare StandardSave, step == epoch):
+        # readable forever — resume continues at the next epoch's start
+        state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
+        mgr.close()
+        return state, step + 1, 0
+    out = mgr.restore(step, args=ocp.args.Composite(
+        state=ocp.args.StandardRestore(abstract),
+        meta=ocp.args.JsonRestore()))
+    mgr.close()
+    meta = out["meta"]
+    return out["state"], int(meta["epoch"]), int(meta["step_in_epoch"])
+
+
+# --------------------------------------------------------- simple epoch API
 
 
 def save(save_dir: str, state: Any, *, epoch: int,
          keep: Optional[int] = DEFAULT_KEEP) -> None:
-    """Save TrainState for an epoch. All processes call this (orbax
-    coordinates the multi-host write — the analogue of every rank calling
-    save_checkpoint at reference train.py:125, minus the redundant copies)."""
+    """Synchronous epoch-keyed save (simple API; the train loop uses
+    :class:`Checkpointer`)."""
     mgr = _manager(save_dir, keep)
     mgr.save(epoch, args=ocp.args.StandardSave(state))
     mgr.wait_until_finished()
@@ -40,9 +128,8 @@ def save(save_dir: str, state: Any, *, epoch: int,
 
 def restore_latest(save_dir: str, template: Any
                    ) -> Optional[Tuple[Any, int]]:
-    """Restore the newest checkpoint as (state, next_epoch), or None if the
-    directory holds none. ``template`` (a concretely-sharded TrainState)
-    pins shardings/dtypes so restoration lands directly in the FSDP layout."""
+    """Restore the newest epoch-keyed checkpoint as (state, next_epoch),
+    or None if the directory holds none."""
     path = os.path.abspath(os.path.expanduser(save_dir))
     if not os.path.isdir(path):
         return None

@@ -82,6 +82,8 @@ class TrainConfig:
     seed: int = 42
     save_dir: str = "ckpt"
     resume: bool = False
+    ckpt_every_steps: int = 0     # also save mid-epoch every N steps (0=off)
+    ckpt_sync: bool = False       # disable async checkpointing (debugging)
     grad_accum_steps: int = 1
     dtype: str = "float32"        # compute dtype: float32 | bfloat16
     remat: bool = False           # checkpoint transformer layers
@@ -119,6 +121,13 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--save-dir", type=str, default="ckpt")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --save-dir")
+    p.add_argument("--ckpt-every-steps", type=int, default=0,
+                   help="also checkpoint mid-epoch every N steps (0 = "
+                        "epoch-end only); a preemption then loses at most "
+                        "N steps")
+    p.add_argument("--ckpt-sync", action="store_true",
+                   help="synchronous checkpoint writes (async overlap is "
+                        "the default)")
     p.add_argument("--model", type=str, default="mlp",
                    choices=["mlp", "transformer", "moe"])
     p.add_argument("--dtype", type=str, default="float32",
@@ -184,6 +193,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         seed=args.seed,
         save_dir=args.save_dir,
         resume=args.resume,
+        ckpt_every_steps=args.ckpt_every_steps,
+        ckpt_sync=args.ckpt_sync,
         grad_accum_steps=args.grad_accum_steps,
         dtype=args.dtype,
         remat=args.remat,

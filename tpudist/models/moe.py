@@ -46,10 +46,14 @@ Params = Dict
 
 
 def capacity(cfg: ModelConfig, n_tokens: int) -> int:
-    """Static per-expert token budget: cf · (routed pairs) / E, floored at
-    one row and rounded up to keep every assignment at cf >= 1 exactly."""
+    """Static per-expert token budget: ceil(cf · routed pairs / E), floored
+    at one row. The ceil is taken over the exact product — truncating the
+    product to int first (e.g. 7.9999 → 7 under a fractional cf) could
+    under-allocate a slot relative to the documented rounding (r2 advisor
+    finding)."""
+    import math
     pairs = n_tokens * cfg.expert_top_k
-    return max(1, -(-int(pairs * cfg.capacity_factor) // cfg.n_experts))
+    return max(1, math.ceil(pairs * cfg.capacity_factor / cfg.n_experts))
 
 
 def group_size(cfg: ModelConfig, n_tokens: int) -> int:

@@ -115,7 +115,7 @@ _BLOCKWISE_MIN_SEQ = 2048
 _BLOCKWISE_CHUNK = 1024
 
 
-def _use_flash(q_shape, k_shape) -> bool:
+def _use_flash(q_shape, k_shape, causal: bool = True) -> bool:
     """Route attention through the pallas flash kernel? TPU only (the
     interpreter would crawl on CPU — the dense/blockwise paths stay the
     CPU-test reference), aligned shapes only, TPUDIST_NO_FLASH=1 escape.
@@ -132,7 +132,7 @@ def _use_flash(q_shape, k_shape) -> bool:
     if jax.default_backend() != "tpu":
         return False
     from tpudist.ops.pallas import flash_attention as fa
-    return fa.supports(q_shape, k_shape)
+    return fa.supports(q_shape, k_shape, causal=causal)
 
 
 def _attention(q, k, v, *, causal: bool = True, cos=None, sin=None):
@@ -149,7 +149,7 @@ def _attention(q, k, v, *, causal: bool = True, cos=None, sin=None):
     q/k arrive UNROTATED and the rotation happens here — fused into the
     flash kernel on TPU (saves the rotated tensors' HBM round-trip),
     applied up front otherwise."""
-    if _use_flash(q.shape, k.shape):
+    if _use_flash(q.shape, k.shape, causal):
         from tpudist.ops.pallas.flash_attention import flash_attention
         return flash_attention(q, k, v, cos=cos, sin=sin, causal=causal)
     if cos is not None:
@@ -292,10 +292,47 @@ def param_specs(cfg: ModelConfig, *, fsdp_axis: str = "fsdp",
     }
 
 
+def _xent_value(logits: jax.Array, targets: jax.Array):
+    """(loss, logz): reductions in f32 whatever the logits dtype."""
+    lf = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(lf, axis=-1)
+    gold = jnp.take_along_axis(lf, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold), logz
+
+
+@jax.custom_vjp
 def _xent(logits: jax.Array, targets: jax.Array) -> jax.Array:
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-    return jnp.mean(logz - gold)
+    return _xent_value(logits, targets)[0]
+
+
+def _xent_fwd(logits, targets):
+    loss, logz = _xent_value(logits, targets)
+    return loss, (logits, logz, targets)
+
+
+def _xent_bwd(res, ct):
+    # Same math as autodiff — dlogits = (softmax − onehot)·ct/T — but the
+    # onehot is an iota compare fused into the softmax elementwise pass.
+    # Autodiff instead derives the gold-logit term through take_along_axis's
+    # transpose, which XLA lowers to a row scatter into the embedding grad:
+    # measured 2.5 ms/step at ~98 GB/s on v5e at the bench shape (scatter
+    # serializes on row conflicts; every token hits the same small target
+    # set here). One dense fusion replaces it. The cotangent carries the
+    # logits' own dtype (bf16 under mixed precision) — the dh/dE matmuls
+    # round it to bf16 for the MXU either way, and the f32 round-trip was
+    # 3.7 GB of HBM at the bench shape.
+    logits, logz, targets = res
+    n = logits.size // logits.shape[-1]
+    p = jnp.exp(logits.astype(jnp.float32) - logz[..., None])
+    onehot = (jax.lax.broadcasted_iota(
+        jnp.int32, logits.shape, logits.ndim - 1)
+        == targets[..., None].astype(jnp.int32))
+    dlogits = ((p - onehot.astype(jnp.float32)) * (ct / n)).astype(
+        logits.dtype)
+    return dlogits, None
+
+
+_xent.defvjp(_xent_fwd, _xent_bwd)
 
 
 def _chunked_head_xent(embed: jax.Array, h: jax.Array, targets: jax.Array,
@@ -311,8 +348,8 @@ def _chunked_head_xent(embed: jax.Array, h: jax.Array, targets: jax.Array,
 
     @jax.checkpoint
     def chunk_loss(hx, tx):
-        logits = (hx @ embed.T).astype(jnp.float32)
-        return _xent(logits, tx)
+        # logits keep the model dtype; _xent reduces in f32 internally
+        return _xent(hx @ embed.T, tx)
 
     def body(acc, ht):
         return acc + chunk_loss(*ht), None
@@ -363,7 +400,13 @@ def head_loss(emb: jax.Array, h: jax.Array, targets: jax.Array, *,
                 f"sequence length {targets.shape[1]} not divisible by "
                 f"xent_chunks={xent_chunks}")
         return _chunked_head_xent(emb, h, targets, xent_chunks)
-    logits = (h @ emb.T).astype(jnp.float32)
+    # logits keep the model dtype (bf16 under mixed precision): the f32
+    # upcast stored 2× the bytes for a tensor whose only consumers — the
+    # f32 logsumexp inside _xent and the bf16 MXU matmuls of its cotangent
+    # — round exactly the same either way. Measured on v5e batch 56: the
+    # f32 logits+dlogits pair (7.3 GB) forced ~31 ms/step of XLA
+    # auto-rematerialisation.
+    logits = h @ emb.T
     if logits_sharding is not None:
         logits = jax.lax.with_sharding_constraint(logits, logits_sharding)
     return _xent(logits, targets)

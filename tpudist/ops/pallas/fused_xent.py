@@ -29,6 +29,17 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG = -1e30
 
+# Self-contained VMEM budget (see flash_attention._COMPILER_PARAMS): the
+# kernels pick blocks far beyond the 16 MiB default scoped-VMEM limit —
+# block size is the dominant perf lever here because every vocab sweep
+# re-streams the full (tokens, d) h (dE pass) or (vocab, d) embedding
+# (fwd/dh passes) through HBM: at the pre-tune block_t=256 that re-read
+# traffic alone was ~15 GB (≈18 ms) per kernel at bench shapes.
+_COMPILER_PARAMS = pltpu.CompilerParams(
+    dimension_semantics=("parallel", "arbitrary"),
+    vmem_limit_bytes=100 * 1024 * 1024,
+)
+
 
 def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
@@ -117,6 +128,7 @@ def _fwd(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
             pltpu.VMEM((block_t, 1), jnp.float32),
         ],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(h, emb, tgt2)
     return loss[:, 0], lse
 
@@ -236,6 +248,7 @@ def _bwd(block_t, block_v, block_v_bwd, interpret, res, ct_loss):
         out_shape=jax.ShapeDtypeStruct((t, d), h.dtype),
         scratch_shapes=[pltpu.VMEM((block_t, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(*common_in)
 
     # dE pass: token dim innermost so the (vb, d) accumulator block is
@@ -261,6 +274,7 @@ def _bwd(block_t, block_v, block_v_bwd, interpret, res, ct_loss):
         out_shape=jax.ShapeDtypeStruct((v, d), emb.dtype),
         scratch_shapes=[pltpu.VMEM((block_v, d), jnp.float32)],
         interpret=interpret,
+        compiler_params=_COMPILER_PARAMS,
     )(*common_in)
 
     return dh, de, None

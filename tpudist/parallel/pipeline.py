@@ -72,8 +72,10 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
 
     def loss(params: dict, tokens: jax.Array) -> jax.Array:
         if tokens.shape[0] % n_micro:
+            # tokens here is the GLOBAL batch — only the pipe axis is
+            # manualized later, so don't call it a per-shard batch
             raise ValueError(
-                f"per-shard batch {tokens.shape[0]} not divisible by "
+                f"batch {tokens.shape[0]} not divisible by "
                 f"pp_microbatches={n_micro}")
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         # Gather fsdp/tensor weight shards OUTSIDE the manual region (the

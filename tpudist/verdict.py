@@ -22,6 +22,11 @@ import jax
 
 SUCCESS = "success"
 FAIL = "fail"
+# A gate that could not be applied (unknown chip peak, single device):
+# distinct from FAIL so CI/operators can tell "bandwidth was bad" from
+# "nothing to compare against" — the first run on a new TPU generation
+# must not read as a bandwidth regression.
+UNGATEABLE = "ungateable"
 
 
 def _write(path: str, content: str) -> None:
@@ -48,8 +53,14 @@ def write_worker_verdict(path: str, ok: bool) -> None:
 def write_final_verdict(path: str, ok: bool) -> None:
     """Coordinator-only aggregate verdict at ``path`` itself. Call after
     aggregate_ok() (or with a locally-known failure)."""
+    write_final_status(path, SUCCESS if ok else FAIL)
+
+
+def write_final_status(path: str, status: str) -> None:
+    """Coordinator-only: write an explicit status string (SUCCESS / FAIL /
+    UNGATEABLE) — the three-valued form of :func:`write_final_verdict`."""
     if jax.process_index() == 0:
-        _write(path, SUCCESS if ok else FAIL)
+        _write(path, status)
 
 
 def aggregate_ok(local_ok: bool,
