@@ -151,6 +151,28 @@ if [ $PROBE_RC -ne 0 ]; then
   exit 1
 fi
 
+# ---- on-chip kernel self-check (hardware truth gates the pipeline) ---------
+# ALL workers run the Mosaic-compiled kernel lane (tpudist.selfcheck)
+# before training — a pod worker's libtpu cannot initialize standalone, so
+# the lane does its own distributed init and runs replicated; any worker's
+# failure fails the ssh command. A pallas kernel regression that only
+# manifests under the real compiler (layout/VMEM/padding hazards the CPU
+# interpreter hides) turns the pipeline red here instead of shipping — the
+# reference's hardware-truth-gates-publish principle (its ci yaml:222)
+# extended to the kernels the reference never had.
+if [ "${SKIP_SELFCHECK:-0}" != "1" ]; then
+  set +e
+  tpu_ssh all "timeout 900 $RUN_PREFIX python3 -m tpudist.selfcheck"
+  SC_RC=$?
+  set -e
+  if [ $SC_RC -ne 0 ]; then
+    echo "❌ on-chip kernel selfcheck failed (rc=$SC_RC)"
+    fail_verdict
+    exit 1
+  fi
+  echo "✅ on-chip kernel selfcheck passed"
+fi
+
 # ---- the distributed training job ------------------------------------------
 # Any worker's nonzero exit fails the ssh command (srun semantics,
 # slurm_train.sbatch:34-44). The verdict is this wrapper's job, from the
@@ -163,7 +185,10 @@ set -e
 if [ $RC -ne 0 ]; then
   echo "❌ distributed TPU job failed (rc=$RC)"
   fail_verdict
-  exit $RC
+  # clamp to 1: the workload's raw code must not collide with this
+  # script's documented exit contract (2 = sweep gate fail, 3 = sweep
+  # ungateable, 124 = provisioning timeout)
+  exit 1
 fi
 echo "✅ distributed TPU job succeeded"
 echo -n success | gsutil cp - "$GCS_VERDICT"

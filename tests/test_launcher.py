@@ -44,6 +44,8 @@ case "$*" in
     # route by payload: probe / train / sweep
     if [[ "$*" == *"jax.distributed.initialize"* ]]; then
       exit "${STUB_PROBE_RC:-0}"
+    elif [[ "$*" == *"tpudist.selfcheck"* ]]; then
+      exit "${STUB_SELFCHECK_RC:-0}"
     elif [[ "$*" == *"tpudist.train"* ]]; then
       exit "${STUB_TRAIN_RC:-0}"
     elif [[ "$*" == *"tpudist.bench.sweep"* ]]; then
@@ -119,11 +121,14 @@ def test_extra_flags_with_spaces_survive_quoting(stub_env):
     assert r"dir\ with\ spaces" in calls or "'dir with spaces'" in calls
 
 
-def test_workload_failure_writes_fail_and_propagates_rc(stub_env):
+def test_workload_failure_writes_fail_and_exits_1(stub_env):
+    """Training failure exits 1 regardless of the workload's raw code —
+    arbitrary codes must not collide with the documented contract
+    (2 = sweep gate fail, 3 = sweep ungateable, 124 = timeout)."""
     env, stub = stub_env
     env["STUB_TRAIN_RC"] = "3"
     r = launch(env)
-    assert r.returncode == 3
+    assert r.returncode == 1
     assert verdict(stub) == "fail"
     assert (stub / "deleted").exists()
 
@@ -172,6 +177,35 @@ def test_sweep_gate_success_writes_sweep_verdict(stub_env):
     r = launch(env)
     assert r.returncode == 0
     assert verdict(stub, "job_status.txt.sweep") == "success"
+
+
+def test_selfcheck_failure_turns_pipeline_red(stub_env):
+    """A broken Mosaic kernel (selfcheck rc!=0) must produce a 'fail'
+    verdict BEFORE training runs — hardware truth gates the pipeline."""
+    env, stub = stub_env
+    env["STUB_SELFCHECK_RC"] = "1"
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    calls = (stub / "calls.log").read_text()
+    assert "tpudist.selfcheck" in calls
+    assert "tpudist.train" not in calls, \
+        "training must not start after a failed kernel selfcheck"
+
+
+def test_selfcheck_runs_on_all_workers_before_training(stub_env):
+    """All workers (a lone pod worker's libtpu cannot initialize), before
+    the training command."""
+    env, stub = stub_env
+    r = launch(env)
+    assert r.returncode == 0
+    calls = (stub / "calls.log").read_text()
+    sc = calls.index("tpudist.selfcheck")
+    tr = calls.index("tpudist.train")
+    assert sc < tr
+    sc_line = [ln for ln in calls.splitlines()
+               if "tpudist.selfcheck" in ln][0]
+    assert "--worker=all" in sc_line
 
 
 def test_sweep_ungateable_exits_3_distinct_verdict(stub_env):

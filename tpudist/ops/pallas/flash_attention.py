@@ -43,8 +43,6 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from tpudist.ops.gqa import expand_gqa
-
 NEG = -1e30
 
 # The kernels' working set (double-buffered q/k/v/out blocks + f32
@@ -69,6 +67,26 @@ _BMM_TN = (((1,), (1,)), ((0,), (0,)))
 
 def _cdiv(a: int, b: int) -> int:
     return (a + b - 1) // b
+
+
+def _expand_rep(x, rep: int):
+    """Expand a compact (nb/rep, t, d) kv block to the q-head layout
+    (nb, t, d) — inside VMEM, where the copy is registers, not the HBM
+    round-trip the old pre-kernel ``jnp.repeat`` paid (r2 advisor
+    finding). Consecutive q-head slices share one kv head, matching the
+    (batch, head)-flattened index order."""
+    if rep == 1:
+        return x
+    return jnp.repeat(x, rep, axis=0)
+
+
+def _group_sum(x, rep: int):
+    """(nb, t, d) f32 per-q-head partials → compact (nb/rep, t, d) kv-head
+    sums: the transpose of :func:`_expand_rep` (exact dk/dv group-sum)."""
+    if rep == 1:
+        return x
+    nb, t, d = x.shape
+    return x.reshape(nb // rep, rep, t, d).sum(axis=1)
 
 
 def _needed(i, j, block_q: int, block_k: int, causal: bool):
@@ -120,7 +138,7 @@ def _block_scores(q, k, scale, i, j, block_q, block_k, causal):
 
 
 def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int,
-                causal: bool, rope: bool, single: bool):
+                causal: bool, rope: bool, single: bool, rep: int):
     if rope:
         (q_ref, k_ref, v_ref, cq_ref, sq_ref, ck_ref, sk_ref,
          o_ref, lse_ref, *scratch) = refs
@@ -134,7 +152,9 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int,
         # no online-rescale bookkeeping and no f32 accumulator scratch —
         # measured meaningfully faster than the general path at seq 512
         # (no zero-init pass, no acc read-modify-write, no rescale VPU work)
-        q, k, v = q_ref[:], k_ref[:], v_ref[:]
+        q = q_ref[:]
+        k = _expand_rep(k_ref[:], rep)
+        v = _expand_rep(v_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
@@ -158,7 +178,9 @@ def _fwd_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
-        q, k, v = q_ref[:], k_ref[:], v_ref[:]
+        q = q_ref[:]
+        k = _expand_rep(k_ref[:], rep)
+        v = _expand_rep(v_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
@@ -203,12 +225,14 @@ def _fwd(q, k, v, cos, sin, *, scale, block_b, block_q, block_k, causal,
          interpret) -> Tuple[jax.Array, jax.Array]:
     bh, s, d = q.shape
     sk = k.shape[1]
+    rep = bh // k.shape[0]          # grouped-query factor (1 = MHA)
     rope = cos is not None
     grid = (_cdiv(bh, block_b), _cdiv(s, block_q), _cdiv(sk, block_k))
 
     qspec = pl.BlockSpec((block_b, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    kspec = pl.BlockSpec((block_b, block_k, d), lambda b, i, j: (b, j, 0),
+    kspec = pl.BlockSpec((block_b // rep, block_k, d),
+                         lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
     in_specs = [qspec, kspec, kspec]
     args = [q, k, v]
@@ -219,7 +243,7 @@ def _fwd(q, k, v, cos, sin, *, scale, block_b, block_q, block_k, causal,
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal, rope=rope,
-                          single=single),
+                          single=single, rep=rep),
         grid=grid,
         in_specs=in_specs,
         out_specs=[
@@ -261,7 +285,7 @@ def _p_and_ds(q, k, v, do, lse, delta, scale, i, j, block_q, block_k,
 
 
 def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
-               causal: bool, rope: bool, single: bool):
+               causal: bool, rope: bool, single: bool, rep: int):
     if rope:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          cq_ref, sq_ref, ck_ref, sk_ref, dq_ref, *scratch) = refs
@@ -273,13 +297,14 @@ def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     if single:
         # one kv block per q block: dq in one shot, no accumulator scratch
-        q, k = q_ref[:], k_ref[:]
+        q = q_ref[:]
+        k = _expand_rep(k_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
-        _, ds = _p_and_ds(q, k, v_ref[:], do_ref[:], lse_ref[:],
-                          delta_ref[:], scale, i, j, block_q, block_k,
-                          causal)
+        _, ds = _p_and_ds(q, k, _expand_rep(v_ref[:], rep), do_ref[:],
+                          lse_ref[:], delta_ref[:], scale, i, j, block_q,
+                          block_k, causal)
         dq = jax.lax.dot_general(ds.astype(k.dtype), k, _BMM_NN,
                                  preferred_element_type=jnp.float32) * scale
         if rope:
@@ -295,13 +320,14 @@ def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
-        q, k = q_ref[:], k_ref[:]
+        q = q_ref[:]
+        k = _expand_rep(k_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
-        _, ds = _p_and_ds(q, k, v_ref[:], do_ref[:], lse_ref[:],
-                          delta_ref[:], scale, i, j, block_q, block_k,
-                          causal)
+        _, ds = _p_and_ds(q, k, _expand_rep(v_ref[:], rep), do_ref[:],
+                          lse_ref[:], delta_ref[:], scale, i, j, block_q,
+                          block_k, causal)
         acc_ref[:] += jax.lax.dot_general(
             ds.astype(k.dtype), k, _BMM_NN,
             preferred_element_type=jnp.float32)        # (nb, block_q, d)
@@ -317,7 +343,7 @@ def _dq_kernel(*refs, scale: float, block_q: int, block_k: int,
 
 
 def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
-                causal: bool, rope: bool, single: bool):
+                causal: bool, rope: bool, single: bool, rep: int):
     if rope:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          cq_ref, sq_ref, ck_ref, sk_ref,
@@ -330,19 +356,20 @@ def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     if single:
         # one q block per kv block: dk/dv in one shot, no accumulators
-        q, k, do = q_ref[:], k_ref[:], do_ref[:]
+        q, do = q_ref[:], do_ref[:]
+        k = _expand_rep(k_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
-        p, ds = _p_and_ds(q, k, v_ref[:], do, lse_ref[:],
+        p, ds = _p_and_ds(q, k, _expand_rep(v_ref[:], rep), do, lse_ref[:],
                           delta_ref[:], scale, i, j, block_q, block_k,
                           causal)
-        dv_ref[:] = jax.lax.dot_general(
+        dv_ref[:] = _group_sum(jax.lax.dot_general(
             p.astype(do.dtype), do, _BMM_TN,
-            preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-        dk = jax.lax.dot_general(
+            preferred_element_type=jnp.float32), rep).astype(dv_ref.dtype)
+        dk = _group_sum(jax.lax.dot_general(
             ds.astype(q.dtype), q, _BMM_TN,
-            preferred_element_type=jnp.float32) * scale
+            preferred_element_type=jnp.float32), rep) * scale
         if rope:
             dk = _rot_t(dk, ck_ref, sk_ref)
         dk_ref[:] = dk.astype(dk_ref.dtype)
@@ -357,19 +384,22 @@ def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
     @pl.when(_needed(i, j, block_q, block_k, causal))
     def _compute():
-        q, k, do = q_ref[:], k_ref[:], do_ref[:]
+        q, do = q_ref[:], do_ref[:]
+        k = _expand_rep(k_ref[:], rep)
         if rope:
             q = _rot(q, cq_ref, sq_ref)
             k = _rot(k, ck_ref, sk_ref)
-        p, ds = _p_and_ds(q, k, v_ref[:], do, lse_ref[:],
+        p, ds = _p_and_ds(q, k, _expand_rep(v_ref[:], rep), do, lse_ref[:],
                           delta_ref[:], scale, i, j, block_q, block_k,
                           causal)
-        dv_acc[:] += jax.lax.dot_general(
+        # accumulate COMPACT (nb/rep, block_k, d): the group-sum over the
+        # rep q-head slices happens here, not as an XLA transpose-of-repeat
+        dv_acc[:] += _group_sum(jax.lax.dot_general(
             p.astype(do.dtype), do, _BMM_TN,
-            preferred_element_type=jnp.float32)        # (nb, block_k, d)
-        dk_acc[:] += jax.lax.dot_general(
+            preferred_element_type=jnp.float32), rep)
+        dk_acc[:] += _group_sum(jax.lax.dot_general(
             ds.astype(q.dtype), q, _BMM_TN,
-            preferred_element_type=jnp.float32)        # (nb, block_k, d)
+            preferred_element_type=jnp.float32), rep)
 
     # the final q block always attends to every kv block under causality
     @pl.when(i == ni - 1)
@@ -382,7 +412,7 @@ def _dkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
 
 def _dqkv_kernel(*refs, scale: float, block_q: int, block_k: int,
-                 causal: bool, rope: bool):
+                 causal: bool, rope: bool, rep: int):
     """Merged single-block backward: when one (q, kv) block pair covers the
     whole sequence, dq/dk/dv come out of ONE p/ds recompute instead of the
     two the split kernels pay (one score matmul, one exp sweep and one
@@ -394,23 +424,24 @@ def _dqkv_kernel(*refs, scale: float, block_q: int, block_k: int,
     else:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dk_ref, dv_ref) = refs
-    q, k, do = q_ref[:], k_ref[:], do_ref[:]
+    q, do = q_ref[:], do_ref[:]
+    k = _expand_rep(k_ref[:], rep)
     if rope:
         q = _rot(q, cq_ref, sq_ref)
         k = _rot(k, ck_ref, sk_ref)
-    p, ds = _p_and_ds(q, k, v_ref[:], do, lse_ref[:], delta_ref[:],
-                      scale, 0, 0, block_q, block_k, causal)
+    p, ds = _p_and_ds(q, k, _expand_rep(v_ref[:], rep), do, lse_ref[:],
+                      delta_ref[:], scale, 0, 0, block_q, block_k, causal)
     dq = jax.lax.dot_general(ds.astype(k.dtype), k, _BMM_NN,
                              preferred_element_type=jnp.float32) * scale
     if rope:
         dq = _rot_t(dq, cq_ref, sq_ref)
     dq_ref[:] = dq.astype(dq_ref.dtype)
-    dv_ref[:] = jax.lax.dot_general(
+    dv_ref[:] = _group_sum(jax.lax.dot_general(
         p.astype(do.dtype), do, _BMM_TN,
-        preferred_element_type=jnp.float32).astype(dv_ref.dtype)
-    dk = jax.lax.dot_general(
+        preferred_element_type=jnp.float32), rep).astype(dv_ref.dtype)
+    dk = _group_sum(jax.lax.dot_general(
         ds.astype(q.dtype), q, _BMM_TN,
-        preferred_element_type=jnp.float32) * scale
+        preferred_element_type=jnp.float32), rep) * scale
     if rope:
         dk = _rot_t(dk, ck_ref, sk_ref)
     dk_ref[:] = dk.astype(dk_ref.dtype)
@@ -421,7 +452,8 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     do = ct
     rope = cos is not None
     bh, s, d = q.shape
-    sk = k.shape[1]
+    bkv, sk = k.shape[0], k.shape[1]
+    rep = bh // bkv                 # grouped-query factor (1 = MHA)
     # softmax-jacobian row constant, cheap elementwise fuse outside pallas
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # (bh, s, 1)
@@ -429,7 +461,8 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     if _cdiv(s, block_q) == 1 and _cdiv(sk, block_k) == 1:
         qspec1 = pl.BlockSpec((block_b, block_q, d), lambda b: (b, 0, 0),
                               memory_space=pltpu.VMEM)
-        kspec1 = pl.BlockSpec((block_b, block_k, d), lambda b: (b, 0, 0),
+        kspec1 = pl.BlockSpec((block_b // rep, block_k, d),
+                              lambda b: (b, 0, 0),
                               memory_space=pltpu.VMEM)
         rowspec1 = pl.BlockSpec((block_b, block_q, 1), lambda b: (b, 0, 0),
                                 memory_space=pltpu.VMEM)
@@ -443,14 +476,15 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
             args1 += [cos, sin, cos, sin]
         dq, dk, dv = pl.pallas_call(
             functools.partial(_dqkv_kernel, scale=scale, block_q=block_q,
-                              block_k=block_k, causal=causal, rope=rope),
+                              block_k=block_k, causal=causal, rope=rope,
+                              rep=rep),
             grid=(_cdiv(bh, block_b),),
             in_specs=in_specs1,
             out_specs=[qspec1, kspec1, kspec1],
             out_shape=[
                 jax.ShapeDtypeStruct((bh, s, d), q.dtype),
-                jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-                jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+                jax.ShapeDtypeStruct((bkv, sk, d), k.dtype),
+                jax.ShapeDtypeStruct((bkv, sk, d), v.dtype),
             ],
             interpret=interpret,
             compiler_params=pltpu.CompilerParams(
@@ -463,7 +497,8 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
 
     qspec = pl.BlockSpec((block_b, block_q, d), lambda b, i, j: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    kspec = pl.BlockSpec((block_b, block_k, d), lambda b, i, j: (b, j, 0),
+    kspec = pl.BlockSpec((block_b // rep, block_k, d),
+                         lambda b, i, j: (b, j, 0),
                          memory_space=pltpu.VMEM)
     rowspec = pl.BlockSpec((block_b, block_q, 1),
                            lambda b, i, j: (b, i, 0),
@@ -478,7 +513,7 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal, rope=rope,
-                          single=single_q),
+                          single=single_q, rep=rep),
         grid=(_cdiv(bh, block_b), _cdiv(s, block_q), _cdiv(sk, block_k)),
         in_specs=in_specs,
         out_specs=qspec,
@@ -493,7 +528,8 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     # all q blocks before the kv index advances
     qspec_t = pl.BlockSpec((block_b, block_q, d), lambda b, j, i: (b, i, 0),
                            memory_space=pltpu.VMEM)
-    kspec_t = pl.BlockSpec((block_b, block_k, d), lambda b, j, i: (b, j, 0),
+    kspec_t = pl.BlockSpec((block_b // rep, block_k, d),
+                           lambda b, j, i: (b, j, 0),
                            memory_space=pltpu.VMEM)
     rowspec_t = pl.BlockSpec((block_b, block_q, 1),
                              lambda b, j, i: (b, i, 0),
@@ -508,17 +544,17 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, scale=scale, block_q=block_q,
                           block_k=block_k, causal=causal, rope=rope,
-                          single=single_kv),
+                          single=single_kv, rep=rep),
         grid=(_cdiv(bh, block_b), _cdiv(sk, block_k), _cdiv(s, block_q)),
         in_specs=in_specs_t,
         out_specs=[kvout, kvout],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((bh, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((bkv, sk, d), v.dtype),
         ],
         scratch_shapes=[] if single_kv else [
-            pltpu.VMEM((block_b, block_k, d), jnp.float32),
-            pltpu.VMEM((block_b, block_k, d), jnp.float32),
+            pltpu.VMEM((block_b // rep, block_k, d), jnp.float32),
+            pltpu.VMEM((block_b // rep, block_k, d), jnp.float32),
         ],
         interpret=interpret,
         compiler_params=_COMPILER_PARAMS,
@@ -556,9 +592,14 @@ def _pick_block(s: int, preferred: int) -> int | None:
     return None
 
 
-def _pick_block_b(bh: int, preferred: int) -> int:
-    nb = preferred
-    while bh % nb:
+def _pick_block_b(bh: int, preferred: int, rep: int = 1) -> int:
+    """Largest batch·head fold ≤ preferred dividing bh — and a multiple of
+    the grouped-query factor, so every program's q slice covers whole kv
+    groups (the compact-kv BlockSpec maps q block b to kv block b).
+    ``rep`` always divides bh (rep | h | b·h), so ``rep`` itself is the
+    floor."""
+    nb = max(preferred, rep)
+    while bh % nb or nb % rep:
         nb -= 1
     return nb
 
@@ -586,9 +627,13 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     """Attention without the (b, h, s, s) score tensor in HBM.
 
     q: (batch, seq, heads, head_dim); k/v: (batch, seq_k, kv_heads,
-    head_dim) — grouped-query heads are expanded here (outside the VJP, so
-    dk/dv group-sums fall out of the repeat's transpose). Layout matches
-    models/transformer.py::_attention, which this replaces on TPU.
+    head_dim) — grouped-query k/v stay COMPACT all the way into the
+    kernels: the kv BlockSpecs map each q-head block to its kv-head block
+    (the q-head fold is constrained to whole kv groups), the expansion
+    happens in VMEM, and the dk/dv kernels group-sum back to the compact
+    shape — no heads/kv_heads-times copies of k and v ever touch HBM (the
+    r2 advisor finding against the old pre-kernel ``jnp.repeat``). Layout
+    matches models/transformer.py::_attention, which this replaces on TPU.
     ``cos``/``sin``: optional (seq, head_dim/2) RoPE tables — when given,
     q and k are rotated inside the kernels (see module docstring); the
     tables are positional constants, their cotangent is zero.
@@ -600,7 +645,7 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         interpret = jax.default_backend() != "tpu"
     b, s, h, hd = q.shape
     sk = k.shape[1]
-    k, v = expand_gqa(q, k, v)
+    rep = h // k.shape[2]
     bq = _pick_block(s, block_q)
     bk = _pick_block(sk, block_k)
     if bq is None or bk is None or hd % 128:
@@ -622,10 +667,14 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
             f"rope tables must be (seq, head_dim/2) = ({s}, {hd // 2}) "
             f"with seq == seq_k, got cos {cos.shape}, sin {sin.shape}, "
             f"seq_k {sk}")
-    nb = _pick_block_b(b * h, block_b)
+    if h % k.shape[2]:
+        raise ValueError(
+            f"heads {h} not divisible by kv_heads {k.shape[2]}")
+    nb = _pick_block_b(b * h, block_b, rep)
 
     def to3(x):
-        return x.transpose(0, 2, 1, 3).reshape(b * h, x.shape[1], hd)
+        nh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, x.shape[1], hd)
 
     cosf = None if cos is None else cos.astype(jnp.float32)
     sinf = None if sin is None else sin.astype(jnp.float32)
