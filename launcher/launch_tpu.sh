@@ -27,6 +27,8 @@
 #   IMAGE               docker image to run (default: install this repo's
 #                       package on each worker and run bare python)
 #   TIMEOUT_S           provisioning+run timeout (default 1800)
+#   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
+#                       (debugging a slice with a known-red kernel)
 #   RUN_SWEEP=1         run the gated bandwidth sweep after training
 #   SWEEP_MIN_PCT       sweep gate threshold (default 90, BASELINE.md)
 #   SWEEP_PEAK_GBPS     operator override for the ICI ring peak (GB/s) —

@@ -14,11 +14,9 @@ import pytest
 from tpudist.ops.pallas.fused_xent import fused_lm_head_xent
 
 
-def _ref_loss(h, emb, targets):
-    logits = (h.astype(jnp.float32) @ emb.astype(jnp.float32).T)
-    logz = jax.nn.logsumexp(logits, axis=-1)
-    gold = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
-    return jnp.mean(logz - gold)
+# ONE reference shared with the acceptance gate (tpudist.selfcheck) — a
+# semantic fix must not fork between the lanes (r3 review finding)
+from tpudist.ops.reference import lm_head_xent as _ref_loss  # noqa: E402
 
 
 def _data(t, d, v, seed=0, dtype=jnp.float32):
@@ -118,15 +116,7 @@ def test_flash_attention_compiled_matches_dense_on_chip(kv):
     v = jax.random.normal(ks[2], (b, s, kv, hd), jnp.bfloat16)
     ct = jax.random.normal(ks[3], (b, s, h, hd), jnp.bfloat16)
 
-    def dense(q, k, v):
-        if kv != h:
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
-        sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
-        mask = jnp.tril(jnp.ones((s, s), bool))
-        sc = jnp.where(mask, sc, -1e30)
-        p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(q.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    from tpudist.ops.reference import dense_attention as dense
 
     got = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
     want = jax.jit(dense)(q, k, v)

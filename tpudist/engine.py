@@ -46,8 +46,15 @@ class TrainState(NamedTuple):
 
 
 def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
-    """Adam, parity with ``torch.optim.Adam(lr)`` (reference train.py:85)."""
-    return optax.adam(cfg.lr)
+    """Adam, parity with ``torch.optim.Adam(lr)`` (reference train.py:85).
+
+    Under mixed precision the FIRST moment is stored bf16 (optax
+    ``mu_dtype`` — the standard low-precision-optimizer-state trade; the
+    variance stays f32 for dynamic range): at the flagship shape that is
+    1.07 GB of HBM the step neither stores nor streams. f32 runs keep
+    exact parity with the reference trajectory."""
+    mu_dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else None
+    return optax.adam(cfg.lr, mu_dtype=mu_dtype)
 
 
 def _compute_dtype(cfg: TrainConfig):

@@ -1,8 +1,11 @@
-"""Grouped-query attention head expansion, shared by every attention path.
+"""Grouped-query attention head expansion for the XLA attention paths
+(dense fallback, blockwise) — one definition rather than a copy each.
 
-One definition (rather than a copy per kernel) so a future change — e.g.
-broadcast-reshape instead of ``jnp.repeat`` to keep expanded k/v out of
-HBM — lands everywhere at once.
+The pallas flash kernels do NOT use this: they take compact kv into the
+kernels via BlockSpec indexing and expand inside VMEM
+(flash_attention._expand_rep / _group_sum), precisely to avoid the HBM
+expansion this function performs. Ring attention likewise expands
+per-hop. A GQA semantic change must visit those sites too.
 """
 
 from __future__ import annotations

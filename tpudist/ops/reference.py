@@ -1,0 +1,43 @@
+"""Plain-XLA reference implementations the kernels are checked against.
+
+ONE copy, importable by both the CPU test lane (tests/) and the on-chip
+acceptance gate (tpudist.selfcheck): if these lived in each, a semantic
+fix to one (mask constant, GQA repeat order, xent reduction dtype) could
+silently leave the other checking different math. Deliberately the naive
+formulation — materialised scores, f32 reductions — because obviousness
+is the point of a reference.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = True) -> jax.Array:
+    """Materialised-scores attention. q: (b, s, h, hd); k/v may carry
+    fewer (grouped-query) heads. Softmax in f32, output in q's dtype."""
+    h, kv = q.shape[2], k.shape[2]
+    if kv != h:
+        k = jnp.repeat(k, h // kv, axis=2)
+        v = jnp.repeat(v, h // kv, axis=2)
+    hd = q.shape[-1]
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
+    if causal:
+        s_q, s_k = sc.shape[-2], sc.shape[-1]
+        mask = jnp.tril(jnp.ones((s_q, s_k), bool))
+        sc = jnp.where(mask, sc, -1e30)
+    p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def lm_head_xent(h: jax.Array, emb: jax.Array,
+                 targets: jax.Array) -> jax.Array:
+    """Tied-head mean cross-entropy with whole f32 logits.
+    h: (tokens, d); emb: (vocab, d); targets: (tokens,) int."""
+    logits = h.astype(jnp.float32) @ emb.astype(jnp.float32).T
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[:, None], axis=-1)[:, 0]
+    return jnp.mean(logz - gold)
