@@ -16,7 +16,7 @@ jit.
 
 ``--matrix`` instead benches the whole perf surface — {seq 512, 2048,
 4096} × {plain, fused, chunked LM head} × {flash, no-flash} × {dense,
-moe} (meaningful cells only; see ``matrix_rows``) — printing one JSONL
+gqa, moe} (meaningful cells only; see ``MATRIX_ROWS``) — printing one JSONL
 line per cell and writing the committed artifact ``BENCH_MATRIX.json``
 plus a README-ready markdown table. One command, one artifact: the
 reference's everything-is-an-observable-output stance
@@ -106,7 +106,15 @@ def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
     (chunked over N sequence chunks)."""
     n_dev = jax.device_count()
     batch = per_chip * n_dev
-    if model == "moe":
+    if model == "gqa":
+        # grouped-query flagship (16 q heads, 4 kv heads): the compact-kv
+        # flash kernels hold the dense model's MFU while the kv
+        # projections shrink 4x — BENCH_MATRIX.json row: 105,920 tok/s/chip,
+        # 79.67% MFU on v5e at batch 56 (same batch as dense plain)
+        mcfg = ModelConfig(name="transformer", vocab_size=32000, n_layers=4,
+                           d_model=2048, n_heads=16, n_kv_heads=4,
+                           d_ff=5504, max_seq_len=seq)
+    elif model == "moe":
         # d_ff 2752 per expert: active params/token = attn side + top2/8 of
         # the expert weights ≈ 267M — the same active size as the dense
         # flagship, so the MoE row reads apples-to-apples. (Experts at the
@@ -212,6 +220,7 @@ MATRIX_ROWS = [
     ("transformer", 4096, "plain", True, 6, False),
     ("transformer", 4096, "c4", True, 6, False),
     ("transformer", 4096, "plain", False, 6, False),
+    ("gqa", 512, "plain", True, 56, False),
     ("moe", 512, "plain", True, 24, False),
     ("moe", 512, "fused", True, 24, True),
 ]

@@ -13,19 +13,13 @@ from tpudist.ops.pallas import flash_attention as fa
 
 
 def _dense_ref(q, k, v, causal=True):
-    if k.shape[2] != q.shape[2]:
-        rep = q.shape[2] // k.shape[2]
-        k = jnp.repeat(k, rep, axis=2)
-        v = jnp.repeat(v, rep, axis=2)
-    hd = q.shape[-1]
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) / np.sqrt(hd)
-    if causal:
-        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
-        s = jnp.where(mask, s, -1e30)
-    p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p,
-                      v.astype(jnp.float32)).astype(q.dtype)
+    """Delegates to the ONE shared reference (tpudist.ops.reference) with
+    an f32 upcast — this lane's convention is the strictest reference
+    (scores and PV in f32 regardless of input dtype)."""
+    from tpudist.ops.reference import dense_attention
+    out = dense_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32), causal=causal)
+    return out.astype(q.dtype)
 
 
 def _data(b=1, s=256, h=2, kv=None, hd=128, seed=0, dtype=jnp.float32):

@@ -118,6 +118,23 @@ def _avg_loss(out: str) -> str:
 
 
 @pytest.mark.slow
+def test_two_process_expert_parallel_matches_single_process(tmp_path):
+    """Expert-parallel MoE spanning a process boundary: the dispatch
+    all-to-alls cross hosts."""
+    moe = ["--model", "moe", "--n-samples", "32", "--train-batch-size", "8",
+           "--seq-len", "64", "--d-model", "128", "--n-layers", "2",
+           "--n-heads", "4", "--d-ff", "128", "--vocab-size", "256",
+           "--n-experts", "4", "--expert-top-k", "2", "--epochs", "1",
+           "--expert", "2"]
+    rcs, outs = _run_world(str(tmp_path / "mp"), moe, nprocs=2, timeout=420)
+    assert rcs == [0, 0], outs
+    rcs1, outs1 = _run_world(str(tmp_path / "sp"), moe, nprocs=1,
+                             timeout=420, devices_per_proc=4)
+    assert rcs1 == [0], outs1
+    assert _avg_loss(outs[0]) == _avg_loss(outs1[0])
+
+
+@pytest.mark.slow
 @pytest.mark.parametrize("layout", [["--context", "2"], ["--pipe", "2"]])
 def test_two_process_cp_and_pp_match_single_process(tmp_path, layout):
     """Context- and pipeline-parallel meshes spanning a PROCESS boundary:

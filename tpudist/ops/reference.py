@@ -27,6 +27,9 @@ def dense_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sc = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(hd)
     if causal:
         s_q, s_k = sc.shape[-2], sc.shape[-1]
+        # top-left-aligned tril is wrong for rectangular (decode-style)
+        # shapes; refuse rather than silently mis-mask
+        assert s_q == s_k, f"causal reference needs s_q == s_k, got {q.shape} {k.shape}"
         mask = jnp.tril(jnp.ones((s_q, s_k), bool))
         sc = jnp.where(mask, sc, -1e30)
     p = jax.nn.softmax(sc.astype(jnp.float32), axis=-1).astype(q.dtype)
