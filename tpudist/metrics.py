@@ -45,6 +45,15 @@ class StepTimer:
         self.t0 = time.perf_counter()
 
     def stop(self, result: Any = None) -> float:
+        return self.stop_many(result, 1)
+
+    def stop_many(self, result: Any, n: int) -> float:
+        """One fence covering ``n`` dispatched steps (the train loop fences
+        at logging boundaries, not per step — a per-step fence serializes
+        host and device and costs a full pipeline drain on tunneled
+        backends). The first group absorbs compile and counts as warmup."""
+        if n <= 0:
+            return 0.0
         if result is not None:
             jax.block_until_ready(result)
         dt = time.perf_counter() - self.t0
@@ -53,7 +62,7 @@ class StepTimer:
             self.warmup_s += dt
         else:
             self.elapsed += dt
-            self.steps += 1
+            self.steps += n
         return dt
 
     def steps_per_sec(self) -> float:
