@@ -47,6 +47,11 @@ class StepTimer:
     def stop(self, result: Any = None) -> float:
         return self.stop_many(result, 1)
 
+    @property
+    def warming(self) -> bool:
+        """Still inside the warmup stops (i.e. compile not yet absorbed)."""
+        return self._seen < self.warmup
+
     def stop_many(self, result: Any, n: int) -> float:
         """One fence covering ``n`` dispatched steps (the train loop fences
         at logging boundaries, not per step — a per-step fence serializes
@@ -55,7 +60,9 @@ class StepTimer:
         if n <= 0:
             return 0.0
         if result is not None:
-            jax.block_until_ready(result)
+            # fence via host TRANSFER, not block_until_ready: on tunneled
+            # PJRT backends the latter can return before execution completes
+            jax.device_get(result)
         dt = time.perf_counter() - self.t0
         self._seen += 1
         if self._seen <= self.warmup:

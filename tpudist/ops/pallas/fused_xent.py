@@ -299,8 +299,8 @@ _fused.defvjp(_fused_fwd, _bwd)
 
 
 def fused_lm_head_xent(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
-                       block_t: int = 256, block_v: int = 1280,
-                       block_v_bwd: int = 320,
+                       block_t: int = 512, block_v: int = 2048,
+                       block_v_bwd: int = 1024,
                        interpret: bool = False) -> jax.Array:
     """Mean cross-entropy of a tied LM head, logits never materialised.
 
@@ -310,8 +310,11 @@ def fused_lm_head_xent(h: jax.Array, emb: jax.Array, targets: jax.Array, *,
     Differentiable w.r.t. h and emb. ``interpret=True`` runs the kernels in
     the pallas interpreter (CPU-testable). ``block_v_bwd`` is the vocab
     block of the backward kernels, smaller than the forward's because they
-    carry (block_v, d)-shaped f32 state in VMEM.
-    """
+    carry (block_v, d)-shaped f32 state in VMEM. Defaults re-tuned after
+    the kernels began pinning their own VMEM budget (_COMPILER_PARAMS):
+    vs the old 16 MiB-constrained (256, 1280, 320) blocks, fwd+bwd at the
+    bench shape (t=28672, d=2048, v=32000, bf16) is 117.9 → 102.9 ms on
+    v5e — bigger blocks cut the per-sweep re-streaming of h and emb."""
     t = h.shape[0]
     block_t = min(block_t, t)
     block_v = min(block_v, emb.shape[0])

@@ -179,9 +179,10 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_batches,
             total = loss if total is None else total + loss
             counted += 1
             pending += 1
-            if i == first:
+            if i == first and timer.warming:
                 # fence the first step alone so the timer's warmup absorbs
-                # exactly the trace+compile cost, not a whole fence group
+                # exactly the trace+compile cost, not a whole fence group —
+                # one-shot: later epochs must not pay this drain again
                 timer.stop_many(loss, 1)
                 pending = 0
                 timer.start()
