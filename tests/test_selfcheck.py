@@ -1,0 +1,37 @@
+"""Contract tests for the on-chip acceptance lane (tpudist.selfcheck).
+
+The checks themselves are hardware tests (run on a TPU host, or via the
+launcher gate — tests/test_launcher.py covers the wiring); what the CPU
+lane can pin is the module's contract: the off-TPU refusal (the lane
+must never silently pass by interpreting kernels on CPU) and the check
+registry's integrity.
+"""
+
+import subprocess
+import sys
+
+from tpudist import selfcheck
+
+
+def test_refuses_off_tpu():
+    """Backend != tpu exits 2 — distinct from a check failure (1) — and
+    does not run any check."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tpudist.selfcheck"],
+        env={"PATH": "/usr/bin:/bin", "TPUDIST_PLATFORM": "cpu",
+             "HOME": "/tmp"},
+        capture_output=True, text=True, timeout=180)
+    assert r.returncode == 2, r.stdout + r.stderr
+    assert "refusing" in r.stdout
+    assert "PASS" not in r.stdout and "FAIL" not in r.stdout
+
+
+def test_check_registry_covers_both_kernels_and_both_models():
+    names = [fn.__name__ for fn in selfcheck.CHECKS]
+    assert len(names) == len(set(names))
+    joined = " ".join(names)
+    # the load-bearing coverage: both pallas kernels (incl. the multi-block
+    # long-context schedule and GQA), and a train smoke per model family
+    for needle in ("fused_xent", "flash_attention", "long_context", "gqa",
+                   "train_step", "moe"):
+        assert needle in joined, f"selfcheck lane lost its {needle} check"
