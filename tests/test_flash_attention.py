@@ -130,3 +130,93 @@ def test_fused_rope_matches_rotate_then_attend():
     for g, w, name in zip(got_g, want_g, "q k v".split()):
         np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
                                    rtol=1e-3, err_msg=f"d{name}")
+
+
+def _ref_lse(q, k, v, causal):
+    """Reference per-row log-sum-exp of the scaled (masked) scores."""
+    hd = q.shape[-1]
+    sc = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                    k.astype(jnp.float32)) / np.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((q.shape[1], k.shape[1]), bool))
+        sc = jnp.where(mask, sc, -1e30)
+    return jax.nn.logsumexp(sc, axis=-1)          # (b, h, s)
+
+
+@pytest.mark.parametrize("causal", [True, False])
+def test_with_lse_forward(causal):
+    q, k, v = _data()
+    o, lse = fa.flash_attention_with_lse(q, k, v, causal=causal,
+                                         block_q=128, block_k=128,
+                                         interpret=True)
+    np.testing.assert_allclose(np.asarray(o),
+                               np.asarray(_dense_ref(q, k, v, causal)),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(lse),
+                               np.asarray(_ref_lse(q, k, v, causal)),
+                               atol=2e-5, rtol=1e-5)
+
+
+def test_with_lse_gradients_include_dlse():
+    """A loss consuming BOTH outputs: the lse cotangent must flow (it
+    folds into the backward's delta constant) — checked against autodiff
+    of the dense reference computing the same pair."""
+    q, k, v = _data(s=256)
+    kc = jax.random.split(jax.random.PRNGKey(7), 2)
+    ct_o = jax.random.normal(kc[0], q.shape)
+    ct_l = jax.random.normal(kc[1], (q.shape[0], q.shape[2], q.shape[1]))
+
+    def loss_kernel(q, k, v):
+        o, lse = fa.flash_attention_with_lse(q, k, v, causal=True,
+                                             block_q=128, block_k=128,
+                                             interpret=True)
+        return jnp.vdot(o, ct_o) + jnp.vdot(lse, ct_l)
+
+    def loss_ref(q, k, v):
+        return (jnp.vdot(_dense_ref(q, k, v, True), ct_o)
+                + jnp.vdot(_ref_lse(q, k, v, True), ct_l))
+
+    g_got = jax.grad(loss_kernel, argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")
+
+
+def test_partial_merge_matches_full_attention():
+    """The ring building block: attend to two kv halves separately
+    (non-causal), merge the (o, lse) partials with the logsumexp rule, and
+    the merged result — AND its gradients through both kernel calls —
+    must match single-call full attention."""
+    q, k, v = _data(s=256)
+    k1, k2 = k[:, :128], k[:, 128:]
+    v1, v2 = v[:, :128], v[:, 128:]
+
+    def merged(q, k1, v1, k2, v2):
+        o1, l1 = fa.flash_attention_with_lse(q, k1, v1, causal=False,
+                                             block_q=128, block_k=128,
+                                             interpret=True)
+        o2, l2 = fa.flash_attention_with_lse(q, k2, v2, causal=False,
+                                             block_q=128, block_k=128,
+                                             interpret=True)
+        lse = jnp.logaddexp(l1, l2)                       # (b, h, s)
+        w1 = jnp.exp(l1 - lse).transpose(0, 2, 1)[..., None]
+        w2 = jnp.exp(l2 - lse).transpose(0, 2, 1)[..., None]
+        return o1 * w1 + o2 * w2
+
+    got = merged(q, k1, v1, k2, v2)
+    want = fa.flash_attention(q, k, v, causal=False, block_q=128,
+                              block_k=128, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
+
+    ct = jax.random.normal(jax.random.PRNGKey(11), q.shape)
+    g_got = jax.grad(lambda q, k, v: jnp.vdot(merged(
+        q, k[:, :128], v[:, :128], k[:, 128:], v[:, 128:]), ct),
+        argnums=(0, 1, 2))(q, k, v)
+    g_want = jax.grad(lambda q, k, v: jnp.vdot(fa.flash_attention(
+        q, k, v, causal=False, block_q=128, block_k=128, interpret=True),
+        ct), argnums=(0, 1, 2))(q, k, v)
+    for g, w, name in zip(g_got, g_want, "qkv"):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w), atol=1e-4,
+                                   rtol=1e-3, err_msg=f"d{name}")

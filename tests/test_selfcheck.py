@@ -7,19 +7,23 @@ must never silently pass by interpreting kernels on CPU) and the check
 registry's integrity.
 """
 
+import os
 import subprocess
 import sys
 
 from tpudist import selfcheck
 
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 
 def test_refuses_off_tpu():
     """Backend != tpu exits 2 — distinct from a check failure (1) — and
     does not run any check."""
+    env = dict(os.environ)
+    env["TPUDIST_PLATFORM"] = "cpu"
     r = subprocess.run(
         [sys.executable, "-m", "tpudist.selfcheck"],
-        env={"PATH": "/usr/bin:/bin", "TPUDIST_PLATFORM": "cpu",
-             "HOME": "/tmp"},
+        cwd=REPO, env=env,
         capture_output=True, text=True, timeout=180)
     assert r.returncode == 2, r.stdout + r.stderr
     assert "refusing" in r.stdout

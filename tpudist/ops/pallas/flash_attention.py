@@ -449,14 +449,19 @@ def _dqkv_kernel(*refs, scale: float, block_q: int, block_k: int,
 
 def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
     q, k, v, o, lse, cos, sin = res
-    do = ct
+    do, dlse = ct
     rope = cos is not None
     bh, s, d = q.shape
     bkv, sk = k.shape[0], k.shape[1]
     rep = bh // bkv                 # grouped-query factor (1 = MHA)
-    # softmax-jacobian row constant, cheap elementwise fuse outside pallas
+    # softmax-jacobian row constant, cheap elementwise fuse outside pallas.
+    # An lse cotangent (callers that consume the log-sum-exp, e.g. a ring
+    # merge of per-hop partials) folds in exactly here: d lse_i / d s_ij =
+    # p_ij, so its score-space contribution is p·dlse — the same shape as
+    # the −p·delta term, absorbed as delta − dlse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # (bh, s, 1)
+    delta = delta - dlse.astype(jnp.float32)
 
     if _cdiv(s, block_q) == 1 and _cdiv(sk, block_k) == 1:
         qspec1 = pl.BlockSpec((block_b, block_q, d), lambda b: (b, 0, 0),
@@ -567,10 +572,12 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
 @functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, cos, sin, scale, block_b, block_q, block_k, causal,
            interpret):
-    o, _ = _fwd(q, k, v, cos, sin, scale=scale, block_b=block_b,
+    """Returns (o, lse): BOTH differentiable outputs — lse's cotangent
+    folds into the backward's delta constant (see _bwd). Callers that
+    ignore lse get a zero dlse from autodiff, which subtracts away."""
+    return _fwd(q, k, v, cos, sin, scale=scale, block_b=block_b,
                 block_q=block_q, block_k=block_k, causal=causal,
                 interpret=interpret)
-    return o
 
 
 def _flash_fwd(q, k, v, cos, sin, scale, block_b, block_q, block_k,
@@ -578,7 +585,7 @@ def _flash_fwd(q, k, v, cos, sin, scale, block_b, block_q, block_k,
     o, lse = _fwd(q, k, v, cos, sin, scale=scale, block_b=block_b,
                   block_q=block_q, block_k=block_k, causal=causal,
                   interpret=interpret)
-    return o, (q, k, v, o, lse, cos, sin)
+    return (o, lse), (q, k, v, o, lse, cos, sin)
 
 
 _flash.defvjp(_flash_fwd, _bwd)
@@ -618,6 +625,43 @@ def supports(q_shape, k_shape, *, causal: bool = True, block_q: int = 512,
             and _pick_block(sk, block_k) is not None)
 
 
+def _prepare(q, k, v, causal, block_b, block_q, block_k, interpret,
+             api_name: str):
+    """Shared validation + (b, s, h, hd) → (b·h, s, hd) folding for both
+    public entry points (one copy: the shape rules must not drift between
+    them). Returns (q3, k3, v3, nb, bq, bk, interpret)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, s, h, hd = q.shape
+    sk = k.shape[1]
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(sk, block_k)
+    if bq is None or bk is None or hd % 128:
+        raise ValueError(
+            f"{api_name} needs seq multiples of 128 and head_dim "
+            f"multiples of 128, got q {q.shape}, k {k.shape}; gate call "
+            f"sites on flash_attention.supports()")
+    if causal and s != sk:
+        # The causal mask compares unoffset absolute row/col indices, which
+        # is wrong for kv-cache/cross-attention offsets (q row i should see
+        # kv cols <= i + sk - s). No caller passes such shapes today; fail
+        # loudly rather than mask silently wrong (r2 advisor finding).
+        raise ValueError(
+            f"causal=True requires seq_q == seq_k (got {s} vs {sk}): the "
+            f"kernel has no notion of a kv offset")
+    if h % k.shape[2]:
+        raise ValueError(
+            f"heads {h} not divisible by kv_heads {k.shape[2]}")
+    rep = h // k.shape[2]
+    nb = _pick_block_b(b * h, block_b, rep)
+
+    def to3(x):
+        nh = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(b * nh, x.shape[1], hd)
+
+    return to3(q), to3(k), to3(v), nb, bq, bk, interpret
+
+
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                     cos: jax.Array | None = None,
                     sin: jax.Array | None = None,
@@ -641,43 +685,44 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     amortisation); ``interpret=None`` auto-selects the pallas interpreter
     off-TPU so the same code path is CPU-testable.
     """
-    if interpret is None:
-        interpret = jax.default_backend() != "tpu"
     b, s, h, hd = q.shape
     sk = k.shape[1]
-    rep = h // k.shape[2]
-    bq = _pick_block(s, block_q)
-    bk = _pick_block(sk, block_k)
-    if bq is None or bk is None or hd % 128:
-        raise ValueError(
-            f"flash_attention needs seq multiples of 128 and head_dim "
-            f"multiples of 128, got q {q.shape}, k {k.shape}; gate call "
-            f"sites on flash_attention.supports()")
-    if causal and s != sk:
-        # The causal mask compares unoffset absolute row/col indices, which
-        # is wrong for kv-cache/cross-attention offsets (q row i should see
-        # kv cols <= i + sk - s). No caller passes such shapes today; fail
-        # loudly rather than mask silently wrong (r2 advisor finding).
-        raise ValueError(
-            f"causal=True requires seq_q == seq_k (got {s} vs {sk}): the "
-            f"kernel has no notion of a kv offset")
     if cos is not None and (s != sk or cos.shape != (s, hd // 2)
                             or sin.shape != cos.shape):
         raise ValueError(
             f"rope tables must be (seq, head_dim/2) = ({s}, {hd // 2}) "
             f"with seq == seq_k, got cos {cos.shape}, sin {sin.shape}, "
             f"seq_k {sk}")
-    if h % k.shape[2]:
-        raise ValueError(
-            f"heads {h} not divisible by kv_heads {k.shape[2]}")
-    nb = _pick_block_b(b * h, block_b, rep)
-
-    def to3(x):
-        nh = x.shape[2]
-        return x.transpose(0, 2, 1, 3).reshape(b * nh, x.shape[1], hd)
-
+    q3, k3, v3, nb, bq, bk, interpret = _prepare(
+        q, k, v, causal, block_b, block_q, block_k, interpret,
+        "flash_attention")
     cosf = None if cos is None else cos.astype(jnp.float32)
     sinf = None if sin is None else sin.astype(jnp.float32)
-    o = _flash(to3(q), to3(k), to3(v), cosf, sinf, 1.0 / (hd ** 0.5), nb,
-               bq, bk, causal, interpret)
+    o, _ = _flash(q3, k3, v3, cosf, sinf, 1.0 / (hd ** 0.5),
+                  nb, bq, bk, causal, interpret)
     return o.reshape(b, h, s, hd).transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                             causal: bool = True, block_b: int = 8,
+                             block_q: int = 512, block_k: int = 512,
+                             interpret: bool | None = None):
+    """:func:`flash_attention` that also returns the per-row log-sum-exp.
+
+    Returns (o (b, s, h, hd), lse (b, h, s) f32). lse is DIFFERENTIABLE —
+    its cotangent folds into the backward's delta row constant at zero
+    extra kernel work — which is what a partial-attention merge needs:
+    combining per-hop results (o_i, lse_i) with
+    ``lse = logaddexp(...); o = Σ exp(lse_i − lse)·o_i`` backpropagates
+    correctly through each hop's kernel. This is the building block for
+    ring attention consuming each hop through the flash kernel (future
+    work, DESIGN.md); no RoPE fusion here — rotate q/k before calling.
+    """
+    b, s, h, hd = q.shape
+    q3, k3, v3, nb, bq, bk, interpret = _prepare(
+        q, k, v, causal, block_b, block_q, block_k, interpret,
+        "flash_attention_with_lse")
+    o, lse = _flash(q3, k3, v3, None, None, 1.0 / (hd ** 0.5),
+                    nb, bq, bk, causal, interpret)
+    return (o.reshape(b, h, s, hd).transpose(0, 2, 1, 3),
+            lse.reshape(b, h, s))
