@@ -220,6 +220,7 @@ MATRIX_ROWS = [
     ("transformer", 4096, "plain", True, 6, False),
     ("transformer", 4096, "c4", True, 6, False),
     ("transformer", 4096, "plain", False, 6, False),
+    ("transformer", 8192, "plain", True, 3, False),
     ("gqa", 512, "plain", True, 56, False),
     ("moe", 512, "plain", True, 24, False),
     ("moe", 512, "fused", True, 24, True),
