@@ -130,6 +130,86 @@ def test_zigzag_halves_causal_attention_flops(ctx_mesh):
     assert zig_fl < 0.65 * dense_fl, (zig_fl, dense_fl)
 
 
+def test_ring_flash_hops_match_einsum_causal(ctx_mesh):
+    """Flash-kernel hops (pallas interpreter on CPU) vs the einsum
+    reference schedule: same zigzag ring, kernel-eligible chunk shapes
+    (c = 2048/8/2 = 128, head_dim 128), GQA compact kv on the ring."""
+    key = jax.random.PRNGKey(11)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 2048, 2, 128))
+    k = jax.random.normal(kk, (1, 2048, 1, 128))
+    v = jax.random.normal(kv_, (1, 2048, 1, 128))
+    flash = make_ring_attention(ctx_mesh, "context", causal=True,
+                                use_flash=True)
+    einsum = make_ring_attention(ctx_mesh, "context", causal=True,
+                                 use_flash=False)
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(einsum(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_hops_grads_match_einsum(ctx_mesh):
+    """Backward through the lse merge: each hop's kernel receives an
+    (do, dlse) cotangent pair that must reproduce the einsum ring's
+    gradients — the differentiable-lse contract."""
+    key = jax.random.PRNGKey(12)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 2048, 1, 128))
+    k = jax.random.normal(kk, (1, 2048, 1, 128))
+    v = jax.random.normal(kv_, (1, 2048, 1, 128))
+    flash = make_ring_attention(ctx_mesh, "context", causal=True,
+                                use_flash=True)
+    einsum = make_ring_attention(ctx_mesh, "context", causal=True,
+                                 use_flash=False)
+
+    def loss(ring):
+        return lambda q, k, v: jnp.sum(ring(q, k, v) ** 2)
+
+    gf = jax.grad(loss(flash), argnums=(0, 1, 2))(q, k, v)
+    ge = jax.grad(loss(einsum), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, ge):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-4)
+
+
+def test_ring_flash_hops_non_causal(ctx_mesh):
+    """Contig non-causal ring through the kernel (whole-shard unmasked
+    hops merged by lse) vs the einsum reference."""
+    key = jax.random.PRNGKey(13)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, 1024, 1, 128))
+    k = jax.random.normal(kk, (1, 1024, 1, 128))
+    v = jax.random.normal(kv_, (1, 1024, 1, 128))
+    flash = make_ring_attention(ctx_mesh, "context", causal=False,
+                                use_flash=True)
+    einsum = make_ring_attention(ctx_mesh, "context", causal=False,
+                                 use_flash=False)
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(einsum(q, k, v)),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_flash_shape_gate(ctx_mesh, monkeypatch):
+    """use_flash=True with kernel-ineligible shapes must raise loudly
+    (head_dim 16 < 128), and the auto path must fall back silently —
+    through the SHAPE gate, not the backend gate (the interpret env var
+    takes the backend guard out of the way)."""
+    from tpudist.ops.ring_attention import flash_hops_supported
+    q, k, v = _qkv(jax.random.PRNGKey(14))      # s=64, d=16: ineligible
+    assert not flash_hops_supported(q.shape, k.shape)
+    ring = make_ring_attention(ctx_mesh, "context", causal=True,
+                               use_flash=True)
+    with pytest.raises(ValueError, match="flash_hops_supported"):
+        ring(q, k, v)
+    # auto (None) must reach the shape check (backend guard disarmed) and
+    # still route to einsum for these shapes
+    monkeypatch.setenv("TPUDIST_RING_FLASH_INTERPRET", "1")
+    auto = make_ring_attention(ctx_mesh, "context", causal=True)
+    np.testing.assert_allclose(np.asarray(auto(q, k, v)),
+                               np.asarray(_attention(q, k, v, causal=True)),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_zigzag_degenerate_single_device_ring(devices8):
     """Regression (r2 review): a context axis of size 1 must reduce to
     plain local causal attention — the zigzag schedule's peeled final hop
@@ -139,4 +219,28 @@ def test_zigzag_degenerate_single_device_ring(devices8):
     ring = make_ring_attention(mesh1, "context", causal=True)
     want = np.asarray(_attention(q, k, v, causal=True))
     np.testing.assert_allclose(np.asarray(ring(q, k, v)), want,
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal,s", [(True, 256), (False, 256),
+                                      (True, 128)])
+def test_flash_degenerate_single_device_ring(devices8, causal, s):
+    """use_flash on a size-1 context axis must run exactly one local
+    kernel call (r4 review: the contig-flash init+peel pair would consume
+    the local block twice; correct only by merge idempotence and 2× the
+    compute) and match the einsum path. s=128 is hop-INELIGIBLE (half
+    chunks of 64) but whole-shard eligible — the gate must accept it on a
+    degenerate ring (r4 review)."""
+    mesh1 = build_mesh(ParallelConfig(data=8, context=1), devices=devices8)
+    key = jax.random.PRNGKey(15)
+    kq, kk, kv_ = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (1, s, 2, 128))
+    k = jax.random.normal(kk, (1, s, 1, 128))
+    v = jax.random.normal(kv_, (1, s, 1, 128))
+    flash = make_ring_attention(mesh1, "context", causal=causal,
+                                use_flash=True)
+    einsum = make_ring_attention(mesh1, "context", causal=causal,
+                                 use_flash=False)
+    np.testing.assert_allclose(np.asarray(flash(q, k, v)),
+                               np.asarray(einsum(q, k, v)),
                                rtol=2e-5, atol=2e-5)

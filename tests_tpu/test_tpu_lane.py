@@ -136,6 +136,26 @@ def test_flash_attention_compiled_matches_dense_on_chip(kv):
                                    err_msg=f"d{name}")
 
 
+@pytest.mark.parametrize("kv", [4, 2])
+def test_flash_attention_long_context_on_chip(kv):
+    """Multi-block Mosaic schedule (seq 2048 = 4 kv blocks); kv=2 compiles
+    the in-kernel GQA _expand_rep/_group_sum under the accumulator
+    schedule (r3 advisor: no on-chip coverage of multi-block GQA before
+    this). The body LIVES in tpudist.selfcheck (the acceptance gate) so
+    the two lanes cannot drift — same rule as _ref_loss above."""
+    from tpudist import selfcheck
+    selfcheck._check_flash_long(kv=kv)
+
+
+def test_ring_flash_merge_on_chip():
+    """The ring-attention hop merge compiled on chip: two disjoint-kv
+    kernel calls merged via merge_partials equal one whole-kv call, fwd +
+    grads (dlse folding) — the per-hop operation of the CP flash path.
+    Body shared with the acceptance gate (tpudist.selfcheck)."""
+    from tpudist import selfcheck
+    selfcheck.check_ring_flash_merge()
+
+
 def test_moe_train_step_smoke_on_chip():
     """MoE dispatch einsums + expert FFN compile and train on the chip."""
     from tpudist import data as tdata, engine

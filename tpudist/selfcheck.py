@@ -109,18 +109,22 @@ def check_flash_attention_gqa():
     _check_flash(kv=2)
 
 
-def check_flash_attention_long_context():
+def _check_flash_long(kv: int):
     """The MULTI-block schedule (seq 2048 = 4 kv blocks): online-softmax
     rescale, accumulator revisits, causal block skipping — a disjoint
     Mosaic code path from the single-block specialisation the seq-512
-    checks compile. Compared against the blockwise XLA decomposition."""
+    checks compile. kv < h additionally compiles the in-kernel GQA
+    _expand_rep/_group_sum under the accumulator schedule (r3 advisor:
+    flash is the default at all sequence lengths, so a GQA model at seq
+    ≥ 1024 hits this path with no other on-chip coverage). Compared
+    against the blockwise XLA decomposition."""
     from tpudist.ops.blockwise_attention import blockwise_causal_attention
     from tpudist.ops.pallas.flash_attention import flash_attention
     b, s, h, hd = 1, 2048, 4, 128
     ks = jax.random.split(jax.random.PRNGKey(1), 4)
     q = jax.random.normal(ks[0], (b, s, h, hd), jnp.bfloat16)
-    k = jax.random.normal(ks[1], (b, s, h, hd), jnp.bfloat16)
-    v = jax.random.normal(ks[2], (b, s, h, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, kv, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, kv, hd), jnp.bfloat16)
     ct = jax.random.normal(ks[3], (b, s, h, hd), jnp.bfloat16)
     got = jax.jit(lambda q, k, v: flash_attention(q, k, v))(q, k, v)
     want = jax.jit(lambda q, k, v: blockwise_causal_attention(
@@ -133,6 +137,58 @@ def check_flash_attention_long_context():
     g_want = jax.jit(jax.grad(lambda a, b_, c: jnp.vdot(
         blockwise_causal_attention(a, b_, c), ct).astype(jnp.float32),
         argnums=(0, 1, 2)))(q, k, v)
+    for g, w, name in zip(g_got, g_want, "q k v".split()):
+        np.testing.assert_allclose(np.asarray(g, np.float32),
+                                   np.asarray(w, np.float32), atol=0.5,
+                                   err_msg=f"d{name}")
+
+
+def check_flash_attention_long_context():
+    _check_flash_long(kv=4)
+
+
+def check_flash_attention_gqa_long_context():
+    _check_flash_long(kv=2)
+
+
+def check_ring_flash_merge():
+    """The ring-attention hop merge on chip: two disjoint-kv kernel calls
+    merged with merge_partials (lse = logaddexp, o = Σ exp(lse_i − lse)·o_i)
+    must equal one whole-kv kernel call — forward AND gradients (the dlse
+    cotangent folding into the kernels' delta constant). This is exactly
+    the per-hop operation of ops.ring_attention's flash path, minus the
+    ppermute (one chip has no ring); the multichip dryrun exercises the
+    full ring on a virtual mesh."""
+    from tpudist.ops.pallas.flash_attention import flash_attention_with_lse
+    from tpudist.ops.ring_attention import merge_partials
+    b, s, h, hd = 2, 1024, 4, 128
+    ks = jax.random.split(jax.random.PRNGKey(2), 4)
+    q = jax.random.normal(ks[0], (b, s, h, hd), jnp.bfloat16)
+    k = jax.random.normal(ks[1], (b, s, 2, hd), jnp.bfloat16)
+    v = jax.random.normal(ks[2], (b, s, 2, hd), jnp.bfloat16)
+    ct = jax.random.normal(ks[3], (b, s, h, hd), jnp.bfloat16)
+    c = s // 2
+
+    def whole(q, k, v):
+        o, _ = flash_attention_with_lse(q, k, v, causal=False)
+        return o.astype(jnp.float32)
+
+    def merged(q, k, v):
+        o1, l1 = flash_attention_with_lse(q, k[:, :c], v[:, :c],
+                                          causal=False)
+        o2, l2 = flash_attention_with_lse(q, k[:, c:], v[:, c:],
+                                          causal=False)
+        o, _ = merge_partials(o1.astype(jnp.float32), l1,
+                              o2.astype(jnp.float32), l2)
+        return o
+
+    got = jax.jit(merged)(q, k, v)
+    want = jax.jit(whole)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=3e-2)
+    g_got = jax.jit(jax.grad(lambda a, b_, c_: jnp.vdot(
+        merged(a, b_, c_), ct), argnums=(0, 1, 2)))(q, k, v)
+    g_want = jax.jit(jax.grad(lambda a, b_, c_: jnp.vdot(
+        whole(a, b_, c_), ct), argnums=(0, 1, 2)))(q, k, v)
     for g, w, name in zip(g_got, g_want, "q k v".split()):
         np.testing.assert_allclose(np.asarray(g, np.float32),
                                    np.asarray(w, np.float32), atol=0.5,
@@ -183,6 +239,8 @@ CHECKS = [
     check_flash_attention,
     check_flash_attention_gqa,
     check_flash_attention_long_context,
+    check_flash_attention_gqa_long_context,
+    check_ring_flash_merge,
     check_train_step_smoke,
     check_moe_smoke,
 ]
