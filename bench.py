@@ -23,10 +23,14 @@ reference's everything-is-an-observable-output stance
 (reference slurm_train.sbatch:38,43) applied to performance claims.
 
 ``--fused-xent`` benches the pallas fused LM-head variant
-(tpudist.ops.pallas.fused_xent): slower at the plain path's plateau batch
-(its backward recomputes logits blocks twice) but it removes the
-(tokens, vocab) logits tensor from HBM entirely — batch 96+ trains on one
-v5e, where the plain path OOMs.
+(tpudist.ops.pallas.fused_xent): it removes the (tokens, vocab) logits
+tensor from HBM entirely — batch 96+ trains on one v5e, where the plain
+path OOMs. Its FLOP floor is 4 head matmuls vs the plain path's 3 (the
+backward must recompute logits once; r4's merged backward kernel reaches
+that floor — head-only fwd+bwd at the bench shape measured 95.7 ms vs
+113.8 ms for the r3 split kernels and 75.2 ms plain, i.e. 1.27× plain
+against the 1.33× FLOP ratio), so at batches where plain fits, plain
+stays the default.
 """
 
 from __future__ import annotations
@@ -221,6 +225,9 @@ MATRIX_ROWS = [
     ("transformer", 4096, "c4", True, 6, False),
     ("transformer", 4096, "plain", False, 6, False),
     ("transformer", 8192, "plain", True, 3, False),
+    # long-context frontier: flash + remat + chunked head, batch 1-2
+    ("transformer", 16384, "c8", True, 2, True),
+    ("transformer", 32768, "c16", True, 1, True),
     ("gqa", 512, "plain", True, 56, False),
     ("moe", 512, "plain", True, 24, False),
     ("moe", 512, "fused", True, 24, True),

@@ -47,6 +47,25 @@ def test_gradients_match_reference():
                                    rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("t", [60, 136])
+def test_gradients_match_with_supergroup_chunking(t):
+    """block_t_bwd far below t drives the merged backward's partial
+    machinery: t=60 → one kernel call with 8 supergroups and a masked
+    token remainder; t=136 → 17 supergroups → 3 outer calls at the
+    _MAX_PARTIALS=8 cap (f32 accumulation across calls), incl. a
+    single-supergroup tail."""
+    h, emb, tgt = _data(t, 32, 100)
+    g_got = jax.grad(
+        lambda h, e: fused_lm_head_xent(h, e, tgt, block_t=16, block_v=32,
+                                        block_v_bwd=32, block_t_bwd=8,
+                                        interpret=True),
+        argnums=(0, 1))(h, emb)
+    g_want = jax.grad(_ref_loss, argnums=(0, 1))(h, emb, tgt)
+    for a, b in zip(g_got, g_want):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
 def test_bf16_inputs():
     h, emb, tgt = _data(32, 16, 64, dtype=jnp.bfloat16)
     got = fused_lm_head_xent(h, emb, tgt, block_t=16, block_v=32,

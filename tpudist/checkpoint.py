@@ -35,10 +35,31 @@ import orbax.checkpoint as ocp
 DEFAULT_KEEP = 3
 
 
+def _norm(save_dir: str) -> str:
+    """Normalise a checkpoint root: local paths expand/absolutise; remote
+    URIs (gs://…, which orbax writes natively) pass through untouched —
+    os.path.abspath would mangle the scheme and os.path.isdir returns
+    False for them (r3 advisor: a gs:// --save-dir silently disabled
+    resume)."""
+    if "://" in save_dir:
+        return save_dir
+    return os.path.abspath(os.path.expanduser(save_dir))
+
+
+def _exists(*parts: str) -> bool:
+    """Existence check that works for both local paths and gs:// URIs
+    (etils epath — the same backend orbax uses for remote IO)."""
+    from etils import epath
+    p = epath.Path(parts[0])
+    for q in parts[1:]:
+        p /= q
+    return p.exists()
+
+
 def _manager(save_dir: str, keep: Optional[int] = DEFAULT_KEEP,
              use_async: bool = False) -> ocp.CheckpointManager:
     return ocp.CheckpointManager(
-        os.path.abspath(os.path.expanduser(save_dir)),
+        _norm(save_dir),
         options=ocp.CheckpointManagerOptions(
             max_to_keep=keep, create=True,
             enable_async_checkpointing=use_async))
@@ -90,8 +111,8 @@ def restore_latest_full(save_dir: str, template: Any
     the directory holds none. ``template`` (a concretely-sharded
     TrainState) pins shardings/dtypes so restoration lands directly in the
     FSDP layout."""
-    path = os.path.abspath(os.path.expanduser(save_dir))
-    if not os.path.isdir(path):
+    path = _norm(save_dir)
+    if not _exists(path):
         return None
     mgr = _manager(save_dir, None)
     step = mgr.latest_step()
@@ -99,7 +120,7 @@ def restore_latest_full(save_dir: str, template: Any
         mgr.close()
         return None
     abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    if not os.path.isdir(os.path.join(path, str(step), "meta")):
+    if not _exists(path, str(step), "meta"):
         # legacy epoch-keyed layout (bare StandardSave, step == epoch):
         # readable forever — resume continues at the next epoch's start
         state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
@@ -130,8 +151,7 @@ def restore_latest(save_dir: str, template: Any
                    ) -> Optional[Tuple[Any, int]]:
     """Restore the newest epoch-keyed checkpoint as (state, next_epoch),
     or None if the directory holds none."""
-    path = os.path.abspath(os.path.expanduser(save_dir))
-    if not os.path.isdir(path):
+    if not _exists(_norm(save_dir)):
         return None
     mgr = _manager(save_dir, None)
     step = mgr.latest_step()

@@ -93,14 +93,12 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
             raise ValueError(
                 "pipe and context parallelism both manualize their own "
                 "mesh axis in a shard_map and do not compose; pick one")
-        if cfg.fused_xent or cfg.xent_chunks:
-            raise ValueError(
-                "the pipeline path computes the plain whole-logits head "
-                "per microbatch; --fused-xent/--xent-chunks do not apply")
         from tpudist.parallel.pipeline import make_pp_loss_fn
         pp_loss = make_pp_loss_fn(cfg.model, mesh,
                                   n_microbatches=cfg.pp_microbatches,
-                                  dtype=dt, remat=cfg.remat)
+                                  dtype=dt, remat=cfg.remat,
+                                  xent_chunks=cfg.xent_chunks,
+                                  fused_xent=cfg.fused_xent)
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch

@@ -17,7 +17,10 @@ from jax.sharding import PartitionSpec as P
 def sanitize_specs(shape_tree, spec_tree, mesh: Mesh):
     """Drop sharding axes that don't divide the corresponding dim evenly
     (e.g. a vocab of 97 over fsdp=2): those dims fall back to replicated,
-    which is always legal. Keeps model PartitionSpecs mesh-agnostic."""
+    which is always legal. Tuple axes keep their longest dividing PREFIX
+    (r4 review: vocab 1000 over (fsdp=8, tensor=4) must stay 8-way
+    fsdp-sharded, not fall all the way back to replicating the biggest
+    tensor). Keeps model PartitionSpecs mesh-agnostic."""
     def fix(shape, spec):
         dims = list(spec) + [None] * (len(shape.shape) - len(spec))
         out = []
@@ -26,10 +29,15 @@ def sanitize_specs(shape_tree, spec_tree, mesh: Mesh):
                 out.append(None)
                 continue
             axes_t = axes if isinstance(axes, tuple) else (axes,)
+            kept = []
             ways = 1
             for a in axes_t:
+                if size % (ways * mesh.shape[a]):
+                    break
+                kept.append(a)
                 ways *= mesh.shape[a]
-            out.append(axes if size % ways == 0 else None)
+            out.append(tuple(kept) if len(kept) > 1
+                       else (kept[0] if kept else None))
         return P(*out)
     return jax.tree.map(fix, shape_tree, spec_tree,
                         is_leaf=lambda x: isinstance(x, P))

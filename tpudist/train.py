@@ -222,8 +222,13 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_batches,
         log0(f"Epoch {epoch + 1:2d} finished. Avg loss: {last_avg:.4f}")
         eval_loss = float(eval_fn(state, eval_batch))
         log0(f"Epoch {epoch + 1:2d} eval loss: {eval_loss:.4f}")
+        # steps_counted < n_steps marks a resumed partial epoch: the
+        # stdout Avg then covers only the post-resume steps, so the
+        # record is self-describing for loss-parity dashboards (r3
+        # advisor finding)
         metrics.log(kind="epoch", epoch=epoch, avg_loss=last_avg,
-                    eval_loss=eval_loss,
+                    eval_loss=eval_loss, steps_counted=counted,
+                    n_steps=n_steps,
                     steps_per_sec=timer.steps_per_sec(),
                     steps_per_sec_per_chip=timer.steps_per_sec_per_chip())
         # resume position: next epoch from its first batch. Async: blocks
