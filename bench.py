@@ -105,7 +105,7 @@ def best_prior_bench() -> float | None:
 
 def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
               model: str = "transformer", remat: bool = False,
-              moe_group: int = 512) -> TrainConfig:
+              moe_group: int = 256) -> TrainConfig:
     """One matrix cell's TrainConfig. ``head``: plain | fused | cN
     (chunked over N sequence chunks)."""
     n_dev = jax.device_count()
@@ -125,10 +125,17 @@ def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
         # dense model's d_ff 5504 total 1.2B params, whose f32 Adam state
         # alone exceeds one v5e's 16 GB HBM past batch 4 — that shape
         # belongs to multi-chip expert parallelism, which the dryrun's
-        # expert-axis mesh exercises.) Group 512, batch 24/chip: measured
-        # optimum on v5e — 66.9k tok/s, 55.5% active-MFU; group 2048 drops
-        # to 60.0k (dispatch/combine einsum FLOPs scale linearly with
-        # group size), batch 32 to 61.7k.
+        # expert-axis mesh exercises.) Group 256, batch 32/chip: r4
+        # measured optimum on v5e — 70.1k tok/s, 58.1% active-MFU (r3: 66.9k
+        # at g512/b24; g128 55.4%, g384 56.7%, b40 53.4%, b48 OOM; an
+        # index/gather dispatch prototype measured ~60k — its backward
+        # scatter-adds serialize at ~21 GB/s, so the einsum dispatch
+        # stays). The remaining gap to the dense 80% is structural at
+        # one-chip batch: ~12% extra expert FLOPs from capacity-factor
+        # slots (cf·k/E rows computed, k/E counted active), ~3% dispatch/
+        # combine einsums, ~19 ms/step of Adam+weight HBM traffic for the
+        # 815M TOTAL params (profiled: three ~6.4 ms 630 GB/s fusions),
+        # and cap=80-row expert matmuls vs the MXU's appetite.
         mcfg = ModelConfig(name="moe", vocab_size=32000, n_layers=4,
                            d_model=2048, n_heads=16, n_kv_heads=16,
                            d_ff=2752, max_seq_len=seq, n_experts=8,
@@ -225,12 +232,14 @@ MATRIX_ROWS = [
     ("transformer", 4096, "c4", True, 6, False),
     ("transformer", 4096, "plain", False, 6, False),
     ("transformer", 8192, "plain", True, 3, False),
-    # long-context frontier: flash + remat + chunked head, batch 1-2
-    ("transformer", 16384, "c8", True, 2, True),
-    ("transformer", 32768, "c16", True, 1, True),
+    # long-context frontier, batch 1-2 with the chunked head. No remat at
+    # 16k: activations fit one v5e and remat cost 9 MFU points (41.2% vs
+    # 50.1% measured r4)
+    ("transformer", 16384, "c8", True, 2, False),
+    ("transformer", 32768, "c16", True, 1, False),
     ("gqa", 512, "plain", True, 56, False),
-    ("moe", 512, "plain", True, 24, False),
-    ("moe", 512, "fused", True, 24, True),
+    ("moe", 512, "plain", True, 32, False),
+    ("moe", 512, "fused", True, 32, True),
 ]
 
 
@@ -333,9 +342,10 @@ def main() -> None:
                         "(model:seq:head:flash:per_chip:remat)")
     p.add_argument("--matrix-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_MATRIX.json"))
-    p.add_argument("--moe-group", type=int, default=512,
+    p.add_argument("--moe-group", type=int, default=256,
                    help="MoE routing group size for the matrix's moe rows "
-                        "(dispatch einsum FLOPs scale linearly with it)")
+                        "(dispatch einsum FLOPs scale linearly with it; "
+                        "256 = r4 measured optimum on v5e)")
     args = p.parse_args()
 
     if args.cell:

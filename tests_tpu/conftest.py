@@ -15,6 +15,15 @@ import jax
 import pytest
 
 
+def pytest_configure(config):
+    # Pod workers: a lone process's libtpu cannot initialize — the first
+    # jax.devices() below would hang. Same pattern as tpudist.selfcheck:
+    # distributed init up front (no-op on a single host), so CI can run
+    # this lane on every worker of a slice with `--worker=all`.
+    from tpudist.parallel import distributed
+    distributed.initialize()
+
+
 def _has_tpu() -> bool:
     try:
         return jax.devices()[0].platform == "tpu"

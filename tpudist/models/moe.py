@@ -15,6 +15,17 @@ dim of the FFN weights: contracting a token-sharded dispatch tensor
 against expert-sharded weights makes the SPMD partitioner emit the
 all-to-alls — no hand-written collectives (the scaling-book recipe).
 
+Einsum-vs-gather dispatch, measured (r4): an index-based dispatch
+prototype (scatter token ids into an (E, cap) slot table, gather expert
+inputs, gather each token's k outputs back) removed the 2·t·E·cap·d
+bookkeeping FLOPs but measured ~60k tok/s on v5e against the einsum
+path's 70.1k at the bench shape — its backward turns both gathers into
+row scatter-adds, which XLA serializes at ~21 GB/s (profiled: four
+2.2 ms fusions/step). The dispatch einsums run on the MXU at full rate
+and their cost is tuned DOWN with the routing group size instead
+(dispatch FLOPs ∝ group; group 256 is the measured optimum — smaller
+groups thin the per-expert matmul below MXU efficiency).
+
 Layers: pre-norm attention identical to the dense transformer (shared
 ``_attn_sublayer``); the FFN half is top-k routed SwiGLU experts plus the
 Switch load-balancing auxiliary loss (aux = E·Σ_e f_e·P_e, added to the
