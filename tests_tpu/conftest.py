@@ -19,9 +19,14 @@ def pytest_configure(config):
     # Pod workers: a lone process's libtpu cannot initialize — the first
     # jax.devices() below would hang. Same pattern as tpudist.selfcheck:
     # distributed init up front (no-op on a single host), so CI can run
-    # this lane on every worker of a slice with `--worker=all`.
-    from tpudist.parallel import distributed
-    distributed.initialize()
+    # this lane on every worker of a slice with `--worker=all`. Guarded:
+    # a host whose chip is busy/absent must keep the documented green
+    # skip (the same failure _has_tpu() catches), not abort collection.
+    try:
+        from tpudist.parallel import distributed
+        distributed.initialize()
+    except Exception:
+        pass
 
 
 def _has_tpu() -> bool:

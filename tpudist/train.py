@@ -261,18 +261,24 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     finally:
         # srun-equivalent signal chain: per-worker verdict → barrier →
         # aggregated verdict file → exit code (slurm_train.sbatch:33-45).
+        agg_timed_out = False
         try:
             if verdict_path:
                 verdict_lib.write_worker_verdict(verdict_path, ok)
-            all_ok = verdict_lib.aggregate_ok(ok)
+            all_ok, agg_timed_out = verdict_lib.aggregate_status(ok)
             if verdict_path:
                 verdict_lib.write_final_verdict(verdict_path, all_ok)
         except Exception as e:
             print(f"tpudist: verdict plumbing failed: {e!r}",
                   file=sys.stderr, flush=True)
             all_ok = False
-        distributed.barrier("tpudist_end")
-        distributed.shutdown()
+        if not agg_timed_out:
+            distributed.barrier("tpudist_end")
+            distributed.shutdown()
+        # else: a peer died mid-run — any further collective (the barrier,
+        # a coordinated shutdown) would hang on it or race the abandoned
+        # aggregation allgather; the verdict is written, just exit and let
+        # the launcher reap the slice (r3 review finding)
     return 0 if ok and all_ok else 1
 
 

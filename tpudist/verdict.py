@@ -52,7 +52,7 @@ def write_worker_verdict(path: str, ok: bool) -> None:
 
 def write_final_verdict(path: str, ok: bool) -> None:
     """Coordinator-only aggregate verdict at ``path`` itself. Call after
-    aggregate_ok() (or with a locally-known failure)."""
+    aggregate_status() (or with a locally-known failure)."""
     write_final_status(path, SUCCESS if ok else FAIL)
 
 
@@ -63,21 +63,26 @@ def write_final_status(path: str, status: str) -> None:
         _write(path, status)
 
 
-def aggregate_ok(local_ok: bool,
-                 timeout_s: float | None = None) -> bool:
+def aggregate_status(local_ok: bool,
+                     timeout_s: float | None = None) -> tuple[bool, bool]:
     """AND-reduce success over all processes (srun semantics: one bad worker
-    fails the job).
+    fails the job). Returns ``(all_ok, timed_out)``.
 
     Failure mode, honestly: if a worker died before reaching this point,
     the allgather does NOT promptly fail — it typically HANGS until the
     distributed runtime's own timeout. The bounded wait here (default 120s,
     ``TPUDIST_AGGREGATE_TIMEOUT_S``) converts that hang into a local
-    ``False`` so this process can still write a ``fail`` verdict; the
-    launcher's outer timeout (launch_tpu.sh TIMEOUT_S) remains the backstop
-    of last resort. The abandoned collective thread may linger until the
-    runtime gives up — acceptable for a process that is about to exit."""
+    ``(False, True)`` so this process can still write a ``fail`` verdict;
+    the launcher's outer timeout (launch_tpu.sh TIMEOUT_S) remains the
+    backstop of last resort. The abandoned collective thread may linger
+    until the runtime gives up — acceptable for a process about to exit,
+    PROVIDED the caller issues no further collectives: ``timed_out=True``
+    tells it to skip the final barrier/shutdown (they would hang on the
+    same dead peer, or race the abandoned allgather) and just exit —
+    which is exactly what train.main does (r3 review: tighter
+    cancellation story)."""
     if jax.process_count() == 1:
-        return local_ok
+        return local_ok, False
     import os
     import threading
 
@@ -100,5 +105,5 @@ def aggregate_ok(local_ok: bool,
     if not result:
         print(f"tpudist: verdict aggregation timed out after {timeout_s}s "
               "(a peer likely died before the barrier) -> fail")
-        return False
-    return result[0]
+        return False, True
+    return result[0], False
