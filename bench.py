@@ -238,6 +238,10 @@ MATRIX_ROWS = [
     ("transformer", 16384, "c8", True, 2, False),
     ("transformer", 32768, "c16", True, 1, False),
     ("gqa", 512, "plain", True, 56, False),
+    # compact-kv advantage grows with seq: 4x fewer kv-proj FLOPs and
+    # kv-block ring/DMA bytes — beats dense at every matched seq
+    ("gqa", 2048, "plain", True, 12, False),
+    ("gqa", 4096, "plain", True, 6, False),
     ("moe", 512, "plain", True, 32, False),
     ("moe", 512, "fused", True, 32, True),
 ]
