@@ -31,18 +31,14 @@ def _data(t, d, v, seed=0, dtype=jnp.float32):
     (512, 4096),     # aligned both dims
     (400, 4096),     # token remainder vs block_t=256 (the r1 dE hazard)
     (512, 5000),     # vocab remainder vs both block_v sizes
+    (20000, 4096),   # 10 supergroups -> two outer dE-partial chunks (r4
+                     # merged backward) + masked supergroup remainder
 ])
 def test_fused_xent_compiled_matches_reference(t, v):
-    h, emb, tgt = _data(t, 256, v)
-    got = fused_lm_head_xent(h, emb, tgt)            # interpret=False
-    want = _ref_loss(h, emb, tgt)
-    np.testing.assert_allclose(float(got), float(want), rtol=1e-4)
-    g_got = jax.grad(lambda h, e: fused_lm_head_xent(h, e, tgt),
-                     argnums=(0, 1))(h, emb)
-    g_want = jax.grad(_ref_loss, argnums=(0, 1))(h, emb, tgt)
-    for a, b in zip(g_got, g_want):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
-                                   rtol=1e-3, atol=1e-5)
+    """Body LIVES in tpudist.selfcheck (the acceptance gate) so the two
+    lanes cannot drift — same rule as the flash checks below."""
+    from tpudist import selfcheck
+    selfcheck._check_fused_xent_shape(t, v)
 
 
 def test_fused_xent_bf16_default_blocks_vmem_fit():
