@@ -145,6 +145,11 @@ def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
     return TrainConfig(
         batch_size=batch, lr=1e-3, seed=0, dtype="bfloat16",
         fused_xent=(head == "fused"), remat=remat,
+        # matrix rows pin their strategy (a row labeled "plain" must not
+        # silently bench whatever auto picks); fused/cN rows are pinned by
+        # their explicit flags below, which "auto" honors; head="auto"
+        # benches the policy itself
+        lm_head=("plain" if head == "plain" else "auto"),
         xent_chunks=(int(head[1:]) if head.startswith("c") else 0),
         data=DataConfig(n_samples=batch),
         model=mcfg,

@@ -231,7 +231,8 @@ if [ "${RUN_SWEEP:-0}" = "1" ]; then
   SWEEP_PEAK_ARG=""
   [ -n "${SWEEP_PEAK_GBPS:-}" ] && SWEEP_PEAK_ARG="--peak-gbps $SWEEP_PEAK_GBPS"
   tpu_ssh all "timeout 900 $RUN_PREFIX python3 -m tpudist.bench.sweep \
-    --kinds all_reduce --min-pct-peak $SWEEP_MIN_PCT $SWEEP_PEAK_ARG \
+    --kinds all_reduce,all_gather,reduce_scatter,all_to_all,ppermute \
+    --min-pct-peak $SWEEP_MIN_PCT $SWEEP_PEAK_ARG \
     --out /tmp/sweep.jsonl"
   SWEEP_RC=$?
   gcloud compute tpus tpu-vm scp "$TPU_NAME:/tmp/sweep.jsonl" sweep.jsonl \

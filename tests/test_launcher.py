@@ -260,6 +260,20 @@ def test_sweep_peak_override_forwarded(stub_env):
     assert "--peak-gbps 123.5" in (stub / "calls.log").read_text()
 
 
+def test_sweep_gates_all_five_collectives(stub_env):
+    """The fabric-acceptance sweep must gate every collective family the
+    framework's parallelism layers ride (all_reduce for DP, all_gather /
+    reduce_scatter for FSDP+TP, all_to_all for EP/Ulysses, ppermute for
+    ring-CP and PP), not just all_reduce."""
+    env, stub = stub_env
+    env["RUN_SWEEP"] = "1"
+    r = launch(env)
+    assert r.returncode == 0
+    calls = (stub / "calls.log").read_text()
+    assert "--kinds all_reduce,all_gather,reduce_scatter,all_to_all,ppermute" \
+        in calls
+
+
 def test_bare_path_installs_package_on_workers(stub_env):
     env, stub = stub_env
     r = launch(env)

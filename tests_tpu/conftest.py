@@ -11,8 +11,16 @@ The whole suite is skipped when no TPU backend is available, so a plain
 ``pytest`` on a CPU box stays green.
 """
 
+import os
+import sys
+
 import jax
 import pytest
+
+# Env vars whose presence means "this host is a pod worker": a failed
+# distributed init there is a real failure, not a skippable condition.
+_POD_ENV = ("TPUDIST_COORDINATOR", "TPU_WORKER_HOSTNAMES",
+            "MEGASCALE_COORDINATOR_ADDRESS")
 
 
 def pytest_configure(config):
@@ -20,13 +28,25 @@ def pytest_configure(config):
     # jax.devices() below would hang. Same pattern as tpudist.selfcheck:
     # distributed init up front (no-op on a single host), so CI can run
     # this lane on every worker of a slice with `--worker=all`. Guarded:
-    # a host whose chip is busy/absent must keep the documented green
-    # skip (the same failure _has_tpu() catches), not abort collection.
+    # a SINGLE host whose chip is busy/absent must keep the documented
+    # green skip (the same failure _has_tpu() catches), not abort
+    # collection — but on a pod worker (env says multi-host) a failed
+    # init means jax.devices() would be exactly the hang the guard exists
+    # to prevent, and the launcher's outer timeout would then read as a
+    # mysterious red lane: fail collection fast and visibly instead
+    # (r4 advisor finding).
     try:
         from tpudist.parallel import distributed
         distributed.initialize()
-    except Exception:
-        pass
+    except Exception as e:
+        print(f"tests_tpu: distributed.initialize() failed: {e!r}",
+              file=sys.stderr, flush=True)
+        if any(os.environ.get(k) for k in _POD_ENV):
+            raise pytest.UsageError(
+                f"distributed init failed on a pod worker "
+                f"(multi-host env {[k for k in _POD_ENV if os.environ.get(k)]} "
+                f"set): refusing to proceed to a hanging jax.devices(); "
+                f"cause: {e!r}")
 
 
 def _has_tpu() -> bool:

@@ -89,6 +89,9 @@ class TrainConfig:
     remat: bool = False           # checkpoint transformer layers
     xent_chunks: int = 0          # stream LM head+loss over N seq chunks
     fused_xent: bool = False      # pallas fused LM head+loss (no HBM logits)
+    lm_head: str = "auto"         # auto | plain | chunked | fused — auto
+    # defers to fused_xent/xent_chunks when set, else picks by the memory
+    # policy (models.transformer.pick_lm_head)
     pp_microbatches: int = 0      # pipeline microbatches (0 = pipe size)
     cp_impl: str = "ring"         # context parallelism: ring | ulysses
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
@@ -138,6 +141,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--xent-chunks", type=int, default=0,
                    help="stream the LM head + cross-entropy over N sequence "
                         "chunks instead of materialising full logits")
+    p.add_argument("--lm-head", type=str, default="auto",
+                   choices=("auto", "plain", "chunked", "fused"),
+                   help="LM-head strategy; auto picks from the logits-pair"
+                        " + activation HBM estimate (the default: the "
+                        "operator never needs to know this flag exists)")
     p.add_argument("--fused-xent", action="store_true",
                    help="compute the LM head + cross-entropy with the fused "
                         "pallas kernel (logits never reach HBM); runs in "
@@ -200,6 +208,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         remat=args.remat,
         xent_chunks=args.xent_chunks,
         fused_xent=args.fused_xent,
+        lm_head=args.lm_head,
         pp_microbatches=args.pp_microbatches,
         cp_impl=args.cp_impl,
         fail_at=args.fail_at,

@@ -61,6 +61,84 @@ def _compute_dtype(cfg: TrainConfig):
     return jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
 
 
+def _device_hbm_bytes() -> float:
+    """Per-device accelerator memory for the head policy. Env override
+    TPUDIST_HBM_BYTES (tests pin it for determinism), else the backend's
+    reported limit, else a 16 GB v5e-class default (CPU backends report
+    no limit; the policy then errs toward the plain head at test shapes,
+    which is what the CPU reference path wants)."""
+    import os
+    env = os.environ.get("TPUDIST_HBM_BYTES")
+    if env:
+        return float(env)
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+        if stats and stats.get("bytes_limit"):
+            return float(stats["bytes_limit"])
+    except Exception:
+        pass
+    return 16e9
+
+
+def _resolve_lm_head(cfg: TrainConfig,
+                     mesh: Mesh | None) -> tuple[bool, int]:
+    """cfg.lm_head -> concrete (fused_xent, xent_chunks) for this run.
+
+    ``auto`` (the default) honors an explicit --fused-xent/--xent-chunks,
+    else asks models.transformer.pick_lm_head with per-DEVICE head tokens
+    (the logits live batch/fsdp/context-sharded) and an analytic train-
+    state estimate (10 B/param under bf16: f32 master + bf16 mu + f32 nu;
+    12 B under f32) — analytic rather than memory_stats so the decision
+    does not depend on whether init_state already materialised the state."""
+    if cfg.lm_head != "auto":
+        # a forced mode with a CONTRADICTORY explicit flag is a config
+        # error (a stale --fused-xent in a launch script must not be
+        # silently dropped), not a precedence question
+        if cfg.lm_head == "plain" and (cfg.fused_xent or cfg.xent_chunks):
+            raise ValueError(
+                "--lm-head plain contradicts --fused-xent/--xent-chunks")
+        if cfg.lm_head == "fused" and cfg.xent_chunks:
+            raise ValueError("--lm-head fused contradicts --xent-chunks")
+        if cfg.lm_head == "chunked" and cfg.fused_xent:
+            raise ValueError("--lm-head chunked contradicts --fused-xent")
+    if cfg.lm_head == "plain":
+        return False, 0
+    if cfg.lm_head == "fused":
+        return True, 0
+    if cfg.lm_head == "chunked":
+        return False, cfg.xent_chunks or 4
+    if cfg.lm_head != "auto":
+        raise ValueError(f"unknown --lm-head {cfg.lm_head!r}")
+    if cfg.fused_xent or cfg.xent_chunks:
+        return cfg.fused_xent, cfg.xent_chunks
+    from tpudist.models import transformer as T
+    m = cfg.model
+    batch_shards = 1 if mesh is None else (
+        mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1))
+    ctx = 1 if mesh is None else mesh.shape.get("context", 1)
+    n_tok = (max(cfg.batch_size // max(batch_shards, 1), 1)
+             * max(m.max_seq_len // max(ctx, 1), 1))
+    hd = m.d_model // m.n_heads
+    attn = 2 * m.d_model * m.d_model + 2 * m.d_model * m.n_kv_heads * hd
+    ffn = 3 * m.d_model * m.d_ff
+    expert_mult = m.n_experts if m.name == "moe" else 1
+    # per-device state share: fsdp and tensor shard every param's storage;
+    # the expert axis additionally shards the (n_experts×) FFN weights
+    wshards = 1 if mesh is None else (
+        mesh.shape.get("fsdp", 1) * mesh.shape.get("tensor", 1))
+    eshards = 1 if mesh is None else mesh.shape.get("expert", 1)
+    n_params_dev = (m.vocab_size * m.d_model
+                    + m.n_layers * attn
+                    + m.n_layers * ffn * expert_mult
+                    / max(eshards, 1)) / max(wshards, 1)
+    state_bytes_per_param = 10 if cfg.dtype == "bfloat16" else 12
+    dtype_bytes = 2 if cfg.dtype == "bfloat16" else 4
+    return T.pick_lm_head(
+        n_tok, m.vocab_size, m.d_model, m.n_layers, dtype_bytes,
+        n_params_dev * state_bytes_per_param,
+        _device_hbm_bytes())
+
+
 def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
                  constrain_logits: bool = False) -> Callable:
     """(params, batch) -> scalar loss, for the configured model.
@@ -86,6 +164,7 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
                              "model (transformer/moe), not mlp")
         return functools.partial(model.loss_fn, dtype=dt)
 
+    fused_xent, xent_chunks = _resolve_lm_head(cfg, mesh)
     pp = mesh is not None and mesh.shape.get("pipe", 1) > 1
     cp = mesh is not None and mesh.shape.get("context", 1) > 1
     if pp:
@@ -97,8 +176,8 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
         pp_loss = make_pp_loss_fn(cfg.model, mesh,
                                   n_microbatches=cfg.pp_microbatches,
                                   dtype=dt, remat=cfg.remat,
-                                  xent_chunks=cfg.xent_chunks,
-                                  fused_xent=cfg.fused_xent)
+                                  xent_chunks=xent_chunks,
+                                  fused_xent=fused_xent)
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch
@@ -111,8 +190,8 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
                 f"{cfg.model.name!r}")
         cp_loss = model.make_cp_loss_fn(cfg.model, mesh, dtype=dt,
                                         remat=cfg.remat,
-                                        xent_chunks=cfg.xent_chunks,
-                                        fused_xent=cfg.fused_xent,
+                                        xent_chunks=xent_chunks,
+                                        fused_xent=fused_xent,
                                         impl=cfg.cp_impl)
 
         def loss(params, batch):
@@ -135,8 +214,8 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
     def loss(params, batch):
         tokens = batch[0] if isinstance(batch, tuple) else batch
         return model.loss_fn(params, tokens, cfg.model, dtype=dt,
-                             remat=cfg.remat, xent_chunks=cfg.xent_chunks,
-                             fused_xent=cfg.fused_xent,
+                             remat=cfg.remat, xent_chunks=xent_chunks,
+                             fused_xent=fused_xent,
                              logits_sharding=logits_sh)
     return loss
 

@@ -375,6 +375,40 @@ def _fused_head_xent(embed: jax.Array, h: jax.Array,
                               targets.reshape(b * s), interpret=interpret)
 
 
+def pick_lm_head(n_tokens_per_device: int, vocab: int, d_model: int,
+                 n_layers: int, dtype_bytes: int, state_bytes: float,
+                 hbm_bytes: float) -> tuple[bool, int]:
+    """Memory-driven LM-head strategy: -> (fused_xent, xent_chunks).
+
+    The head's working set is the (tokens, vocab) logits tensor PLUS its
+    same-shaped cotangent. When that pair fits comfortably, the plain
+    whole-logits path is FLOP-optimal (3 head matmuls; fused/chunked pay a
+    4th for the backward's logits recompute) and measured fastest — v5e
+    matrix: plain 80.1% MFU vs chunked-c4 73.9% at batch 56/seq 512, and
+    still ahead at seq 2048-8192 (BENCH_MATRIX.json). Past the memory
+    cliff the plain path first forces XLA into rematerialisation (measured
+    31 ms/step at batch 56 already) and then OOMs (batch 96); the fused
+    pallas kernel — logits never in HBM at all, strictly less traffic
+    than chunking — is the measured winner there (its reason to exist).
+
+    The estimate: logits pair + a backbone-activation footprint (~12 live
+    (tokens, d_model) buffers per layer under the flash path — at long
+    sequence these crowd the head's budget, which is why the r4 matrix's
+    16k/32k rows could not run plain) charged against HBM minus the train
+    state, with 25% headroom for fusion scratch and fragmentation. The
+    0.75 fraction is calibrated to the measured matrix rows: plain stays
+    plain at batch 56/seq 512 (9.3 GB est vs 10.0 budget on v5e) and at
+    every 24.5k-token long-seq row (8.0 GB est); fused triggers at batch
+    96 (16 GB est) and at the 32k-token 16k/32k frontier rows (10.6 GB
+    est). The boundary rows sit within ~10% of the cut — operators at
+    the edge pin ``--lm-head`` explicitly."""
+    pair = 2 * n_tokens_per_device * vocab * dtype_bytes
+    act = 12 * n_tokens_per_device * d_model * n_layers * dtype_bytes
+    if pair + act <= 0.75 * max(hbm_bytes - state_bytes, 0.0):
+        return False, 0
+    return True, 0
+
+
 def head_loss(emb: jax.Array, h: jax.Array, targets: jax.Array, *,
               xent_chunks: int = 0, fused_xent: bool = False,
               logits_sharding=None) -> jax.Array:

@@ -122,7 +122,14 @@ def _rot_t(x, cos_ref, sin_ref):
 
 
 def _block_scores(q, k, scale, i, j, block_q, block_k, causal):
-    """(nb, block_q, block_k) f32 scaled scores, causally masked."""
+    """(nb, block_q, block_k) f32 scaled scores, causally masked.
+
+    The mask is applied UNCONDITIONALLY even though only diagonal-
+    straddling blocks need it: a scalar ``lax.cond`` skipping it on
+    interior blocks was tried (r5) and measured a 16% step REGRESSION at
+    seq 8192 (421→489 ms) — the branch materialises ``s`` and breaks
+    Mosaic's fusion of the iota/compare/select into the matmul's output
+    pipeline, costing far more than the masked elementwise work saves."""
     s = jax.lax.dot_general(q, k, _BMM_NT,
                             preferred_element_type=jnp.float32) * scale
     if causal:

@@ -65,8 +65,15 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
     """(params, tokens) -> scalar loss, pipelined over ``axis``.
 
     ``tokens``: (batch, seq+1) int32, replicated over ``axis`` (batch dims
-    ride data/fsdp outside the manual region). ``n_microbatches`` 0 means
-    one microbatch per stage — the minimum that fills the pipeline.
+    ride data/fsdp outside the manual region). ``n_microbatches`` 0
+    auto-selects per call: 2 microbatches per stage when the batch
+    divides, else one per stage. The GPipe bubble is (S−1)/(M+S−1) of
+    slots — per-device slot FLOPs scale as (M+S−1)/M, so M=2S cuts the
+    S=2 bubble from 33% to 20% of slots (measured table in DESIGN.md:
+    compiled per-device FLOPs 1.27→1.14× the no-bubble floor at S=2).
+    M=4S would cut it to 11% but quarters the per-microbatch rows the
+    MXU sees; without multi-chip wall-clock evidence the default stays
+    at 2S and ``--pp-microbatches`` overrides.
     ``xent_chunks``/``fused_xent``: LM-head strategy, same semantics as
     the dense path (the head runs once on the stacked completed
     microbatches, so all of head_loss's strategies apply unchanged).
@@ -76,13 +83,18 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
 
     is_moe = cfg.name == "moe"
     n_stages = mesh.shape[axis]
-    n_micro = n_microbatches or n_stages
     if cfg.n_layers % n_stages:
         raise ValueError(
             f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}")
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def loss(params: dict, tokens: jax.Array) -> jax.Array:
+        # auto-M resolves against the actual batch (static under jit):
+        # 2 microbatches/stage when the batch divides — the measured
+        # FLOP-table sweet spot (see docstring) — else the GPipe minimum
+        n_micro = n_microbatches or (
+            2 * n_stages if tokens.shape[0] % (2 * n_stages) == 0
+            else n_stages)
         if tokens.shape[0] % n_micro:
             # tokens here is the GLOBAL batch — only the pipe axis is
             # manualized later, so don't call it a per-shard batch
