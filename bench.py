@@ -118,6 +118,24 @@ def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
         mcfg = ModelConfig(name="transformer", vocab_size=32000, n_layers=4,
                            d_model=2048, n_heads=16, n_kv_heads=4,
                            d_ff=5504, max_seq_len=seq)
+    elif model == "moe_cf1":
+        # capacity_factor 1.0: computed expert rows == counted active rows
+        # (cf 1.25 pays 25% extra FFN FLOPs for fewer dropped tokens —
+        # a quality/throughput knob, benched as its own row, default kept
+        # honest at 1.25). r5 sweep: ~10% step win over cf 1.25.
+        mcfg = ModelConfig(name="moe", vocab_size=32000, n_layers=4,
+                           d_model=2048, n_heads=16, n_kv_heads=16,
+                           d_ff=2752, max_seq_len=seq, n_experts=8,
+                           expert_top_k=2, moe_group_size=moe_group,
+                           capacity_factor=1.0)
+    elif model == "moe_gqa":
+        # MoE backbone with grouped-query attention (16 q heads, 4 kv):
+        # the two "beyond" model families composed — kv projections shrink
+        # 4x on top of the routed FFN
+        mcfg = ModelConfig(name="moe", vocab_size=32000, n_layers=4,
+                           d_model=2048, n_heads=16, n_kv_heads=4,
+                           d_ff=2752, max_seq_len=seq, n_experts=8,
+                           expert_top_k=2, moe_group_size=moe_group)
     elif model == "moe":
         # d_ff 2752 per expert: active params/token = attn side + top2/8 of
         # the expert weights ≈ 267M — the same active size as the dense
@@ -134,8 +152,9 @@ def build_cfg(*, seq: int, per_chip: int, head: str = "plain",
         # one-chip batch: ~12% extra expert FLOPs from capacity-factor
         # slots (cf·k/E rows computed, k/E counted active), ~3% dispatch/
         # combine einsums, ~19 ms/step of Adam+weight HBM traffic for the
-        # 815M TOTAL params (profiled: three ~6.4 ms 630 GB/s fusions),
-        # and cap=80-row expert matmuls vs the MXU's appetite.
+        # 674M TOTAL params (profiled: three ~6.4 ms 630 GB/s fusions),
+        # and cap=80-row expert matmuls vs the MXU's appetite. (Total
+        # params 674M: 65.5M embed + 67M attn + 541M experts.)
         mcfg = ModelConfig(name="moe", vocab_size=32000, n_layers=4,
                            d_model=2048, n_heads=16, n_kv_heads=16,
                            d_ff=2752, max_seq_len=seq, n_experts=8,
@@ -249,6 +268,12 @@ MATRIX_ROWS = [
     ("gqa", 4096, "plain", True, 6, False),
     ("moe", 512, "plain", True, 32, False),
     ("moe", 512, "fused", True, 32, True),
+    # r5 additions: the fused premium isolated at the plain row's batch
+    # (no remat, no batch confound), and MoE coverage past seq 512
+    ("transformer", 512, "fused", True, 56, False),
+    ("moe", 2048, "plain", True, 8, False),
+    ("moe_gqa", 512, "plain", True, 32, False),
+    ("moe_cf1", 512, "plain", True, 32, False),
 ]
 
 

@@ -70,10 +70,11 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
     divides, else one per stage. The GPipe bubble is (S−1)/(M+S−1) of
     slots — per-device slot FLOPs scale as (M+S−1)/M, so M=2S cuts the
     S=2 bubble from 33% to 20% of slots (measured table in DESIGN.md:
-    compiled per-device FLOPs 1.27→1.14× the no-bubble floor at S=2).
-    M=4S would cut it to 11% but quarters the per-microbatch rows the
-    MXU sees; without multi-chip wall-clock evidence the default stays
-    at 2S and ``--pp-microbatches`` overrides.
+    compiled per-device FLOPs 1.50→1.25→1.13× the no-bubble floor at
+    M=S/2S/4S, within 1% of the slot model). M=4S would trim another
+    ~10% but quarters the per-microbatch rows the MXU sees; without
+    multi-chip wall-clock evidence the default stays at 2S and
+    ``--pp-microbatches`` overrides.
     ``xent_chunks``/``fused_xent``: LM-head strategy, same semantics as
     the dense path (the head runs once on the stacked completed
     microbatches, so all of head_loss's strategies apply unchanged).
