@@ -198,3 +198,75 @@ class TestLmHeadAuto:
             self._resolve(56, lm_head="fused", xent_chunks=4)
         with _pytest.raises(ValueError, match="contradicts"):
             self._resolve(56, lm_head="chunked", fused_xent=True)
+
+
+def test_adam_nu_bf16_tracks_f32_trajectory(devices8):
+    """--adam-nu-dtype bfloat16: same Adam math with nu stored bf16 must
+    track the f32-nu trajectory closely over several steps (nu sits under
+    a sqrt: ~bf16-epsilon relative update noise, not a different
+    optimizer), and its state pytree must carry bf16 nu leaves."""
+    import jax.numpy as jnp
+    import optax
+
+    losses = {}
+    for nu_dtype in ("float32", "bfloat16"):
+        cfg = TrainConfig(
+            batch_size=8, lr=1e-3, seed=0, dtype="float32",
+            adam_nu_dtype=nu_dtype,
+            data=DataConfig(n_samples=8),
+            model=ModelConfig(name="transformer", vocab_size=64, n_layers=1,
+                              d_model=32, n_heads=2, n_kv_heads=2, d_ff=64,
+                              max_seq_len=16),
+            parallel=ParallelConfig(data=8))
+        mesh = build_mesh(cfg.parallel, devices=devices8)
+        state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+        if nu_dtype == "bfloat16":
+            adam = [s for s in jax.tree.leaves(
+                state.opt_state, is_leaf=lambda x: isinstance(
+                    x, optax.ScaleByAdamState))
+                if isinstance(s, optax.ScaleByAdamState)]
+            assert adam and all(
+                x.dtype == jnp.bfloat16
+                for x in jax.tree.leaves(adam[0].nu)), "nu not bf16"
+        step = engine.make_train_step(cfg, mesh)
+        toks = data.make_synthetic_tokens(8, 17, 64, seed=0)
+        traj = []
+        for _ in range(5):
+            state, loss = step(state, (toks,))
+            traj.append(float(loss))
+        losses[nu_dtype] = traj
+    np.testing.assert_allclose(losses["bfloat16"], losses["float32"],
+                               rtol=3e-3)
+
+
+def test_adam_nu_bf16_ema_decays_after_gradient_shrink():
+    """The r5 review freeze-catcher: with nu stored bf16, round-to-NEAREST
+    at store kills the EMA once its per-step relative change (1-b2=1e-3)
+    drops below the bf16 half-ulp (~2e-3) — nu ratchets to its historical
+    max and the effective step size never recovers. Stochastic rounding is
+    unbiased, so sub-ulp updates land in expectation and nu must track the
+    f32 EMA's decay. Drive the optimizer directly: big gradients to pump
+    nu up, then small ones; after ~3 half-lives (2000 steps) nu must have
+    decayed by >5x (f32 decays ~7.4x; frozen round-to-nearest stays at
+    ~1.0)."""
+    import jax.numpy as jnp
+
+    opt = engine._adam_low_precision_nu(1e-3)
+    params = {"w": jnp.zeros((256,), jnp.float32)}
+    state = opt.init(params)
+    big = {"w": jnp.ones((256,), jnp.float32)}
+    small = {"w": jnp.full((256,), 1e-2, jnp.float32)}
+
+    @jax.jit
+    def step(state, g):
+        _, new = opt.update(g, state)
+        return new
+
+    for _ in range(50):
+        state = step(state, big)
+    peak = float(jnp.mean(state.nu["w"].astype(jnp.float32)))
+    for _ in range(2000):
+        state = step(state, small)
+    now = float(jnp.mean(state.nu["w"].astype(jnp.float32)))
+    assert peak > 0.04, peak          # nu actually pumped up
+    assert now < peak / 5, (peak, now)  # and actually decayed (no freeze)

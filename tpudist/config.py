@@ -86,6 +86,8 @@ class TrainConfig:
     ckpt_sync: bool = False       # disable async checkpointing (debugging)
     grad_accum_steps: int = 1
     dtype: str = "float32"        # compute dtype: float32 | bfloat16
+    adam_nu_dtype: str = "float32"  # Adam second-moment storage dtype
+    # (bfloat16 = opt-in HBM saving for big optimizer states, engine.py)
     remat: bool = False           # checkpoint transformer layers
     xent_chunks: int = 0          # stream LM head+loss over N seq chunks
     fused_xent: bool = False      # pallas fused LM head+loss (no HBM logits)
@@ -136,6 +138,11 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--dtype", type=str, default="float32",
                    choices=["float32", "bfloat16"])
     p.add_argument("--grad-accum-steps", type=int, default=1)
+    p.add_argument("--adam-nu-dtype", type=str, default="float32",
+                   choices=("float32", "bfloat16"),
+                   help="Adam second-moment storage dtype; bfloat16 trades "
+                        "~1e-3-relative update noise for halved nu HBM "
+                        "traffic (big MoE optimizer states)")
     p.add_argument("--remat", action="store_true",
                    help="rematerialise transformer layers in backward")
     p.add_argument("--xent-chunks", type=int, default=0,
@@ -204,6 +211,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         ckpt_every_steps=args.ckpt_every_steps,
         ckpt_sync=args.ckpt_sync,
         grad_accum_steps=args.grad_accum_steps,
+        adam_nu_dtype=args.adam_nu_dtype,
         dtype=args.dtype,
         remat=args.remat,
         xent_chunks=args.xent_chunks,

@@ -52,9 +52,81 @@ def make_optimizer(cfg: TrainConfig) -> optax.GradientTransformation:
     ``mu_dtype`` — the standard low-precision-optimizer-state trade; the
     variance stays f32 for dynamic range): at the flagship shape the mu
     buffer halves, ~0.54 GB of HBM the step no longer stores or streams.
-    f32 runs keep exact parity with the reference trajectory."""
+    f32 runs keep exact parity with the reference trajectory.
+
+    ``--adam-nu-dtype bfloat16`` additionally stores the SECOND moment
+    bf16 with STOCHASTIC rounding at store (opt-in; see
+    :func:`_stochastic_round_bf16` — nearest-rounding would freeze the
+    EMA, whose per-step relative change is below the bf16 half-ulp).
+    The win is HBM traffic on big optimizer states: ~2.7 GB/step off
+    the MoE model's 674M-param nu read+write (~3 ms/step on v5e,
+    DESIGN.md MoE account). The update math runs in f32 either way:
+    moments are upcast at use, rounded only at store; trajectory
+    agreement and EMA-decay tracking are pinned in
+    tests/test_engine.py."""
     mu_dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else None
+    if cfg.adam_nu_dtype == "bfloat16":
+        return _adam_low_precision_nu(cfg.lr, mu_dtype=mu_dtype)
     return optax.adam(cfg.lr, mu_dtype=mu_dtype)
+
+
+def _stochastic_round_bf16(x: jax.Array, key: jax.Array) -> jax.Array:
+    """f32 → bf16 with STOCHASTIC rounding: add uniform noise in [0, ulp)
+    to the low 16 mantissa bits, then truncate. Unbiased — E[sr(x)] = x —
+    which is what makes a bf16-stored EMA work at all: round-to-NEAREST
+    freezes the second moment once its per-step relative change (1−b2 =
+    1e-3) drops below the bf16 half-ulp (~2e-3), so nu ratchets to its
+    historical max and the effective step size never recovers (r5 review
+    finding). With SR the sub-ulp updates land with probability
+    proportional to their size, so the EMA tracks in expectation — the
+    same reason TPUs do hardware SR for low-precision accumulation."""
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    noise = jax.random.bits(key, x.shape, jnp.uint16).astype(jnp.uint32)
+    bits = (bits + noise) & jnp.uint32(0xFFFF0000)
+    return jax.lax.bitcast_convert_type(bits, jnp.float32).astype(
+        jnp.bfloat16)
+
+
+def _adam_low_precision_nu(lr: float, *, b1: float = 0.9, b2: float = 0.999,
+                           eps: float = 1e-8,
+                           mu_dtype=None) -> optax.GradientTransformation:
+    """optax.adam with the second moment STORED bf16 (optax exposes only
+    ``mu_dtype``). Same math in f32 — decay, bias correction, rsqrt —
+    with nu stochastically rounded to bf16 at store (see
+    :func:`_stochastic_round_bf16` for why nearest-rounding is wrong
+    here) and upcast at use. The SR key is derived from the step count
+    and the leaf index, so the update stays a pure function of
+    (state, grads)."""
+
+    def init(params):
+        mu = jax.tree.map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params)
+        nu = jax.tree.map(lambda p: jnp.zeros_like(p, jnp.bfloat16), params)
+        return optax.ScaleByAdamState(
+            count=jnp.zeros([], jnp.int32), mu=mu, nu=nu)
+
+    def update(grads, state, params=None):
+        count = state.count + 1
+        f32 = lambda t: jax.tree.map(lambda x: x.astype(jnp.float32), t)
+        mu = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                          f32(state.mu), f32(grads))
+        nu = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * g * g,
+                          f32(state.nu), f32(grads))
+        c1 = 1 - b1 ** count.astype(jnp.float32)
+        c2 = 1 - b2 ** count.astype(jnp.float32)
+        updates = jax.tree.map(
+            lambda m, v: -lr * (m / c1) / (jnp.sqrt(v / c2) + eps), mu, nu)
+        mu_store = jax.tree.map(
+            lambda x: x.astype(mu_dtype) if mu_dtype else x, mu)
+        base = jax.random.fold_in(jax.random.PRNGKey(0xADA), count)
+        leaves, treedef = jax.tree.flatten(nu)
+        nu_store = jax.tree.unflatten(treedef, [
+            _stochastic_round_bf16(leaf, jax.random.fold_in(base, i))
+            for i, leaf in enumerate(leaves)])
+        return updates, optax.ScaleByAdamState(
+            count=count, mu=mu_store, nu=nu_store)
+
+    return optax.GradientTransformation(init, update)
 
 
 def _compute_dtype(cfg: TrainConfig):
@@ -87,9 +159,10 @@ def _resolve_lm_head(cfg: TrainConfig,
     ``auto`` (the default) honors an explicit --fused-xent/--xent-chunks,
     else asks models.transformer.pick_lm_head with per-DEVICE head tokens
     (the logits live batch/fsdp/context-sharded) and an analytic train-
-    state estimate (10 B/param under bf16: f32 master + bf16 mu + f32 nu;
-    12 B under f32) — analytic rather than memory_stats so the decision
-    does not depend on whether init_state already materialised the state."""
+    state estimate (f32 master + mu/nu at their configured storage
+    dtypes: 12 B/param full-f32 down to 8 B with bf16 mu and nu) —
+    analytic rather than memory_stats so the decision does not depend on
+    whether init_state already materialised the state."""
     if cfg.lm_head != "auto":
         # a forced mode with a CONTRADICTORY explicit flag is a config
         # error (a stale --fused-xent in a launch script must not be
@@ -131,7 +204,10 @@ def _resolve_lm_head(cfg: TrainConfig,
                     + m.n_layers * attn
                     + m.n_layers * ffn * expert_mult
                     / max(eshards, 1)) / max(wshards, 1)
-    state_bytes_per_param = 10 if cfg.dtype == "bfloat16" else 12
+    # f32 master (4) + mu (bf16 under mixed precision, else f32) + nu
+    # (bf16 when --adam-nu-dtype says so, else f32)
+    state_bytes_per_param = (4 + (2 if cfg.dtype == "bfloat16" else 4)
+                             + (2 if cfg.adam_nu_dtype == "bfloat16" else 4))
     dtype_bytes = 2 if cfg.dtype == "bfloat16" else 4
     return T.pick_lm_head(
         n_tok, m.vocab_size, m.d_model, m.n_layers, dtype_bytes,
