@@ -232,6 +232,9 @@ def _resolve_lm_head(cfg: TrainConfig,
         _device_hbm_bytes())
 
 
+_AUTO_HEAD_LOGGED: set = set()
+
+
 def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
                  constrain_logits: bool = False) -> Callable:
     """(params, batch) -> scalar loss, for the configured model.
@@ -258,6 +261,15 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
         return functools.partial(model.loss_fn, dtype=dt)
 
     fused_xent, xent_chunks = _resolve_lm_head(cfg, mesh)
+    if cfg.lm_head == "auto" and not (cfg.fused_xent or cfg.xent_chunks):
+        # the decision the operator never had to make, made visible once
+        # (rank-0; make_loss_fn runs again for eval — dedup per choice)
+        choice = ("fused" if fused_xent
+                  else f"chunked({xent_chunks})" if xent_chunks else "plain")
+        if choice not in _AUTO_HEAD_LOGGED:
+            _AUTO_HEAD_LOGGED.add(choice)
+            from tpudist.metrics import log0
+            log0(f"tpudist: --lm-head auto -> {choice}")
     pp = mesh is not None and mesh.shape.get("pipe", 1) > 1
     cp = mesh is not None and mesh.shape.get("context", 1) > 1
     if pp:
