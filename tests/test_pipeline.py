@@ -188,3 +188,18 @@ def test_pp_bubble_cost_decreases_with_microbatches():
     assert fl[S] / fl[2 * S] < (2 * S - 1) / S + 0.05, fl
     # auto default == explicit 2S
     assert fl[0] == fl[2 * S], fl
+
+
+def test_pp_gqa_matches_dense():
+    """Pipeline parallelism over a grouped-query model (4 q heads, 2 kv):
+    stage-sharded GQA layers must reproduce the dense loss exactly."""
+    gqa = dataclasses.replace(MODEL, n_heads=4, n_kv_heads=2)
+    toks = _tokens()
+    cfg = dataclasses.replace(_cfg(data=-1, pipe=2), model=gqa)
+    mesh = build_mesh(cfg.parallel)
+    params = engine.init_state(jax.random.PRNGKey(0), cfg, mesh).params
+    pp_loss = make_pp_loss_fn(gqa, mesh, dtype=jnp.float32)
+    from tpudist.models import transformer as T
+    want = T.loss_fn(params, toks, gqa, dtype=jnp.float32)
+    np.testing.assert_allclose(float(jax.jit(pp_loss)(params, toks)),
+                               float(want), rtol=1e-5)

@@ -112,3 +112,24 @@ def test_tp_with_fsdp(devices8):
     _, losses = _run(cfg, mesh, steps=4)
     assert losses[-1] < losses[0]
     assert all(np.isfinite(losses))
+
+
+def test_tp_gqa_matches_unsharded(devices8):
+    """Megatron sharding over a grouped-query model: the kv projections'
+    head dim (2 kv heads) still divides tensor=2, the column/row specs
+    apply unchanged, and the trajectory matches the unsharded run."""
+    import dataclasses
+    gqa = dict(TINY, n_kv_heads=2)
+
+    def cfg_of(parallel):
+        c = _cfg(parallel)
+        return dataclasses.replace(
+            c, model=ModelConfig(name="transformer", **gqa))
+
+    cfg_tp = cfg_of(ParallelConfig(data=2, tensor=2))
+    mesh_tp = build_mesh(cfg_tp.parallel, devices=devices8[:4])
+    cfg_d = cfg_of(ParallelConfig(data=1))
+    mesh_d = build_mesh(cfg_d.parallel, devices=devices8[:1])
+    _, l_tp = _run(cfg_tp, mesh_tp)
+    _, l_d = _run(cfg_d, mesh_d)
+    np.testing.assert_allclose(l_tp, l_d, rtol=2e-4, atol=2e-4)

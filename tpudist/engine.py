@@ -201,6 +201,15 @@ def _resolve_lm_head(cfg: TrainConfig,
         raise ValueError(f"unknown --lm-head {cfg.lm_head!r}")
     if cfg.fused_xent or cfg.xent_chunks:
         return cfg.fused_xent, cfg.xent_chunks
+    return _auto_lm_head(cfg, mesh)
+
+
+def _auto_lm_head(cfg: TrainConfig, mesh: Mesh | None) -> tuple[bool, int]:
+    """The auto policy pick, logged at rank 0 — here, inside the single
+    source of truth, not re-derived at call sites (r5 review). Dedup is
+    once per resolved CHOICE per process: make_loss_fn runs at least
+    twice per run (train + eval), and a repeat of the same line carries
+    no information; a changed choice always prints."""
     from tpudist.models import transformer as T
     m = cfg.model
     batch_shards = 1 if mesh is None else (
@@ -226,10 +235,17 @@ def _resolve_lm_head(cfg: TrainConfig,
     state_bytes_per_param = (4 + (2 if cfg.dtype == "bfloat16" else 4)
                              + (2 if cfg.adam_nu_dtype == "bfloat16" else 4))
     dtype_bytes = 2 if cfg.dtype == "bfloat16" else 4
-    return T.pick_lm_head(
+    fused_xent, xent_chunks = T.pick_lm_head(
         n_tok, m.vocab_size, m.d_model, m.n_layers, dtype_bytes,
         n_params_dev * state_bytes_per_param,
         _device_hbm_bytes())
+    choice = ("fused" if fused_xent
+              else f"chunked({xent_chunks})" if xent_chunks else "plain")
+    if choice not in _AUTO_HEAD_LOGGED:
+        _AUTO_HEAD_LOGGED.add(choice)
+        from tpudist.metrics import log0
+        log0(f"tpudist: --lm-head auto -> {choice}")
+    return fused_xent, xent_chunks
 
 
 _AUTO_HEAD_LOGGED: set = set()
@@ -261,15 +277,6 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
         return functools.partial(model.loss_fn, dtype=dt)
 
     fused_xent, xent_chunks = _resolve_lm_head(cfg, mesh)
-    if cfg.lm_head == "auto" and not (cfg.fused_xent or cfg.xent_chunks):
-        # the decision the operator never had to make, made visible once
-        # (rank-0; make_loss_fn runs again for eval — dedup per choice)
-        choice = ("fused" if fused_xent
-                  else f"chunked({xent_chunks})" if xent_chunks else "plain")
-        if choice not in _AUTO_HEAD_LOGGED:
-            _AUTO_HEAD_LOGGED.add(choice)
-            from tpudist.metrics import log0
-            log0(f"tpudist: --lm-head auto -> {choice}")
     pp = mesh is not None and mesh.shape.get("pipe", 1) > 1
     cp = mesh is not None and mesh.shape.get("context", 1) > 1
     if pp:
