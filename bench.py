@@ -269,6 +269,7 @@ MATRIX_ROWS = [
     # kv-block ring/DMA bytes — beats dense at every matched seq
     ("gqa", 2048, "plain", True, 12, False),
     ("gqa", 4096, "plain", True, 6, False),
+    ("gqa", 8192, "plain", True, 3, False),
     ("moe", 512, "plain", True, 32, False),
     ("moe", 512, "fused", True, 32, True),
     # r5 additions: the fused premium isolated at the plain row's batch
