@@ -233,6 +233,91 @@ def measure(cfg: TrainConfig, iters: int = 60) -> dict:
     }
 
 
+# --------------------------------------------------------- dispatch sweep
+
+
+def _dispatch_cell(cfg, mesh, k: int, n_steps: int, repeats: int) -> dict:
+    """ms/step of the tiny-MLP train loop at superstep length k (k=1 =
+    the per-step dispatch path, including its per-step put_batch — the
+    real thing the superstep replaces)."""
+    from tpudist.parallel import sharding as shd
+    x, y = data.make_synthetic_data(n_steps * cfg.batch_size,
+                                    cfg.data.n_features, cfg.data.seed)
+    bx, by = data.shard_epoch(x, y, batch_size=cfg.batch_size,
+                              seed=cfg.seed, epoch=0)
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+
+    if k == 1:
+        step = engine.make_train_step(cfg, mesh)
+
+        def run_epoch(state):
+            loss = None
+            for i in range(n_steps):
+                state, loss = step(state, (bx[i], by[i]))
+            return state, loss
+    else:
+        superstep = engine.make_superstep(cfg, mesh, k)
+        staged = shd.put_epoch(mesh, (bx, by))
+
+        def run_epoch(state):
+            import jax.numpy as jnp
+            total = jnp.zeros((), jnp.float32)
+            loss = None
+            i = 0
+            while i < n_steps:
+                end = min(n_steps, i + k)
+                slab = jax.tree.map(lambda a: a[i:end], staged)
+                state, total, loss = superstep(state, total, slab)
+                i = end
+            return state, loss
+
+    state, loss = run_epoch(state)            # trace + compile + warm
+    jax.device_get(loss)
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        state, loss = run_epoch(state)
+        jax.device_get(loss)                  # fence
+        times.append((time.perf_counter() - t0) * 1000 / n_steps)
+    ms = statistics.median(times)
+    return {"k": k, "step_ms": round(ms, 4),
+            "steps_per_sec": round(1000 / ms, 1)}
+
+
+def run_dispatch_sweep(out_path: str, n_steps: int = 128,
+                       repeats: int = 5) -> dict:
+    """The dispatch-overhead row: steps/s on the tiny MLP at superstep
+    k=1 vs 8 vs 32. The model is deliberately dispatch-bound (the paper's
+    regime), so the k=1→32 delta IS the per-step dispatch+fence cost;
+    ``dispatch_overhead_ms`` (ms/step at k=1 minus ms/step at k=32) is
+    the tracked artifact metric for future PRs."""
+    from tpudist.parallel import build_mesh
+    cfg = TrainConfig(batch_size=64, lr=1e-3, seed=0,
+                      data=DataConfig(n_samples=n_steps * 64),
+                      parallel=ParallelConfig(data=-1))
+    mesh = build_mesh(cfg.parallel)
+    rows = [_dispatch_cell(cfg, mesh, k, n_steps, repeats)
+            for k in (1, 8, 32)]
+    by_k = {r["k"]: r for r in rows}
+    art = {
+        "metric": "dispatch_overhead_ms_per_step",
+        "value": round(by_k[1]["step_ms"] - by_k[32]["step_ms"], 4),
+        "unit": "ms/step (k=1 minus k=32)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "model": "mlp", "global_batch": cfg.batch_size,
+            "rows": rows,
+            "speedup_k32_vs_k1": round(
+                by_k[32]["steps_per_sec"] / by_k[1]["steps_per_sec"], 3),
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art))
+    return art
+
+
 # ------------------------------------------------------------------ matrix
 
 # (model, seq, head, flash, per_chip[, remat]) — meaningful cells only:
@@ -364,9 +449,11 @@ def markdown_table(rows) -> str:
 
 
 def main() -> None:
-    from tpudist.utils import maybe_force_platform, tune_tpu
+    from tpudist.utils import (maybe_enable_compilation_cache,
+                               maybe_force_platform, tune_tpu)
     maybe_force_platform()
     tune_tpu()
+    maybe_enable_compilation_cache()
 
     p = argparse.ArgumentParser()
     p.add_argument("--fused-xent", action="store_true",
@@ -375,6 +462,11 @@ def main() -> None:
     p.add_argument("--iters", type=int, default=60)
     p.add_argument("--matrix", action="store_true",
                    help="bench the full perf surface; write BENCH_MATRIX.json")
+    p.add_argument("--dispatch-sweep", action="store_true",
+                   help="bench superstep dispatch overhead (tiny MLP, "
+                        "k=1/8/32); write BENCH_DISPATCH.json")
+    p.add_argument("--dispatch-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_DISPATCH.json"))
     p.add_argument("--cell", type=str, default=None,
                    help="internal: run one matrix cell "
                         "(model:seq:head:flash:per_chip:remat)")
@@ -388,6 +480,9 @@ def main() -> None:
 
     if args.cell:
         run_cell(args.cell, args.iters, args.moe_group)
+        return
+    if args.dispatch_sweep:
+        run_dispatch_sweep(args.dispatch_out)
         return
     if args.matrix:
         run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)

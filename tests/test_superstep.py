@@ -1,0 +1,230 @@
+"""Superstep dispatch (engine.make_superstep): the k-step lax.scan path
+must be bitwise-indistinguishable from per-step dispatch — same per-step
+losses, same running loss total, same final params — on both engine paths
+(1-device jit+shardings, 4-device shard_map DP), for mlp and transformer;
+and the train loop's logging/checkpoint boundaries must fire at the same
+global steps with the same values."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpudist import config as config_lib
+from tpudist import data, engine
+from tpudist.config import DataConfig, ModelConfig, ParallelConfig, TrainConfig
+from tpudist.parallel import build_mesh
+from tpudist.parallel import sharding as shd
+
+TINY_TF = ModelConfig(name="transformer", vocab_size=64, n_layers=1,
+                      d_model=32, n_heads=2, n_kv_heads=2, d_ff=64,
+                      max_seq_len=16)
+
+
+def _cfg(model="mlp", **kw):
+    base = dict(batch_size=16, epochs=1, lr=1e-2, seed=0,
+                data=DataConfig(n_samples=16 * 12),
+                parallel=ParallelConfig(data=-1))
+    if model == "transformer":
+        base["model"] = TINY_TF
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _epoch(cfg, n_steps):
+    """(steps, batch, ...) host arrays for one epoch of cfg's model."""
+    if cfg.model.name == "mlp":
+        x, y = data.make_synthetic_data(n_steps * cfg.batch_size,
+                                        cfg.data.n_features, cfg.data.seed)
+        bx, by = data.shard_epoch(x, y, batch_size=cfg.batch_size,
+                                  seed=cfg.seed, epoch=0)
+        return (bx, by)
+    toks = data.make_synthetic_tokens(n_steps * cfg.batch_size,
+                                      cfg.model.max_seq_len + 1,
+                                      cfg.model.vocab_size, cfg.data.seed)
+    perm = np.arange(n_steps * cfg.batch_size)
+    return (toks[perm].reshape(n_steps, cfg.batch_size, -1),)
+
+
+def _run_per_step(cfg, mesh, batches, n_steps):
+    state = engine.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    step = engine.make_train_step(cfg, mesh)
+    total = None
+    losses = []
+    for i in range(n_steps):
+        batch = jax.tree.map(lambda a: a[i], batches)
+        state, loss = step(state, batch)
+        total = loss if total is None else total + loss
+        losses.append(np.asarray(loss))
+    return state, np.asarray(losses), float(total)
+
+
+def _run_superstep(cfg, mesh, batches, n_steps, k):
+    state = engine.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    superstep = engine.make_superstep(cfg, mesh, k)
+    staged = shd.put_epoch(mesh, batches)
+    total = jnp.zeros((), jnp.float32)
+    losses = []
+    i = 0
+    while i < n_steps:
+        end = min(n_steps, i + k)
+        slab = jax.tree.map(lambda a: a[i:end], staged)
+        state, total, step_losses = superstep(state, total, slab)
+        losses.extend(np.asarray(step_losses))
+        i = end
+    return state, np.asarray(losses), float(total)
+
+
+def _assert_bitwise_equal(state_a, state_b, losses_a, losses_b,
+                          total_a, total_b):
+    np.testing.assert_array_equal(losses_a, losses_b)
+    assert total_a == total_b, (total_a, total_b)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        state_a.params, state_b.params)
+    assert int(state_a.step) == int(state_b.step)
+
+
+@pytest.mark.parametrize("model", ["mlp", "transformer"])
+@pytest.mark.parametrize("n_dev", [1, 4])
+def test_superstep_k4_bitwise_matches_per_step(model, n_dev, devices8):
+    """The acceptance-critical parity: the k=4 scan trajectory (losses,
+    running total, final params) is bitwise-identical to per-step
+    dispatch on both engine paths."""
+    cfg = _cfg(model, parallel=ParallelConfig(data=n_dev))
+    mesh = build_mesh(cfg.parallel, devices=devices8[:n_dev])
+    n_steps = 8
+    batches = _epoch(cfg, n_steps)
+    ref = _run_per_step(cfg, mesh, batches, n_steps)
+    got = _run_superstep(cfg, mesh, batches, n_steps, k=4)
+    _assert_bitwise_equal(got[0], ref[0], got[1], ref[1], got[2], ref[2])
+
+
+def test_superstep_partial_tail_runs_true_length(devices8):
+    """n_steps not a k-multiple: the trailing slab runs at its true length
+    (a second compiled shape), and the trajectory still matches per-step
+    bitwise."""
+    cfg = _cfg("mlp", parallel=ParallelConfig(data=4))
+    mesh = build_mesh(cfg.parallel, devices=devices8[:4])
+    n_steps = 10                       # slabs of 4, 4, 2
+    batches = _epoch(cfg, n_steps)
+    ref = _run_per_step(cfg, mesh, batches, n_steps)
+    got = _run_superstep(cfg, mesh, batches, n_steps, k=4)
+    _assert_bitwise_equal(got[0], ref[0], got[1], ref[1], got[2], ref[2])
+    assert len(got[1]) == n_steps
+
+
+def test_make_superstep_rejects_bad_k(devices8):
+    cfg = _cfg("mlp")
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    with pytest.raises(ValueError, match=">= 1"):
+        engine.make_superstep(cfg, mesh, 0)
+
+
+class TestResolveStepsPerDispatch:
+    """config.resolve_steps_per_dispatch: boundary-alignment guard rails."""
+
+    def test_auto_default_aligns_to_log_every(self):
+        # log_every=100: the largest divisor <= 32 is 25
+        assert config_lib.resolve_steps_per_dispatch(_cfg()) == 25
+
+    def test_auto_respects_ckpt_interval(self):
+        cfg = _cfg(log_every=100, ckpt_every_steps=10)
+        # largest common divisor of 100 and 10 that is <= 32
+        assert config_lib.resolve_steps_per_dispatch(cfg) == 10
+
+    def test_auto_log_every_1_forces_per_step(self):
+        assert config_lib.resolve_steps_per_dispatch(_cfg(log_every=1)) == 1
+
+    def test_auto_profiling_forces_per_step(self):
+        cfg = _cfg(profile_dir="/tmp/prof")
+        assert config_lib.resolve_steps_per_dispatch(cfg) == 1
+
+    def test_auto_fail_at_forces_per_step(self):
+        assert config_lib.resolve_steps_per_dispatch(_cfg(fail_at=0)) == 1
+
+    def test_auto_logging_disabled_uses_cap(self):
+        cfg = _cfg(log_every=0)
+        assert (config_lib.resolve_steps_per_dispatch(cfg)
+                == config_lib.SUPERSTEP_CAP)
+
+    def test_explicit_k_must_divide_log_every(self):
+        with pytest.raises(ValueError, match="log-every"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=7, log_every=100))
+
+    def test_explicit_k_must_divide_ckpt_every(self):
+        with pytest.raises(ValueError, match="ckpt-every-steps"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=4, log_every=8,
+                     ckpt_every_steps=6))
+
+    def test_explicit_k_rejected_with_fail_at(self):
+        with pytest.raises(ValueError, match="fail-at"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=4, log_every=8, fail_at=1))
+
+    def test_negative_k_rejected(self):
+        with pytest.raises(ValueError, match="steps-per-dispatch"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=-1))
+
+    def test_explicit_k_passes_when_aligned(self):
+        cfg = _cfg(steps_per_dispatch=4, log_every=8, ckpt_every_steps=16)
+        assert config_lib.resolve_steps_per_dispatch(cfg) == 4
+
+
+def _cli_metrics(tmp_path, capsys, name, extra):
+    """Run the train CLI; return (stdout, metrics.jsonl records)."""
+    from tpudist import train as train_mod
+    save = tmp_path / name
+    rc = train_mod.main(["--epochs", "1", "--train-batch-size", "64",
+                         "--n-samples", "512", "--save-dir", str(save)]
+                        + extra)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    with open(save / "metrics.jsonl") as f:
+        return out, [json.loads(ln) for ln in f]
+
+
+def test_train_loop_boundaries_fire_at_same_global_steps(tmp_path, capsys):
+    """--log-every/--ckpt-every-steps boundaries under superstep dispatch
+    fire at the same global steps, with the same logged losses and the
+    same checkpoint resume positions, as per-step dispatch (8-step epoch:
+    log at 2,4,6,8; mid-epoch ckpt at 4)."""
+    common = ["--log-every", "2", "--ckpt-every-steps", "4"]
+    out1, ref = _cli_metrics(tmp_path, capsys, "k1",
+                             common + ["--steps-per-dispatch", "1"])
+    out2, got = _cli_metrics(tmp_path, capsys, "k2",
+                             common + ["--steps-per-dispatch", "2"])
+
+    def pick(recs, kind, keys):
+        return [{k: r[k] for k in keys} for r in recs if r["kind"] == kind]
+
+    step_keys = ("epoch", "step", "loss")
+    assert pick(got, "step", step_keys) == pick(ref, "step", step_keys)
+    assert [r["step"] for r in pick(ref, "step", ("step",))] == [
+        {"step": s}["step"] for s in (2, 4, 6, 8)]
+    ckpt_keys = ("epoch", "step", "step_in_epoch")
+    assert pick(got, "ckpt", ckpt_keys) == pick(ref, "ckpt", ckpt_keys)
+    assert {r["step_in_epoch"] for r in pick(ref, "ckpt", ckpt_keys)} == \
+        {4, 0}
+    # stdout Avg loss parity rides along
+    assert [ln for ln in out1.splitlines() if "Avg loss" in ln] == \
+        [ln for ln in out2.splitlines() if "Avg loss" in ln]
+
+
+def test_timing_split_recorded(tmp_path, capsys):
+    """The metrics stream carries the compile-vs-run split and the
+    resolved superstep length."""
+    _, recs = _cli_metrics(tmp_path, capsys, "t",
+                           ["--log-every", "4"])
+    timing = [r for r in recs if r["kind"] == "timing"]
+    assert len(timing) == 1
+    t = timing[0]
+    assert t["steps_per_dispatch"] == 4
+    assert t["compile_warmup_s"] > 0 and t["run_s"] > 0
+    assert t["steps"] > 0

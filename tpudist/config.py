@@ -99,9 +99,76 @@ class TrainConfig:
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
+    steps_per_dispatch: int = 0   # superstep length k: one compiled
+    # lax.scan dispatch covers k train steps (engine.make_superstep).
+    # 0 = auto (resolve_steps_per_dispatch); 1 = per-step dispatch.
+    compilation_cache_dir: Optional[str] = None  # persistent XLA
+    # compilation cache (also via TPUDIST_COMPILATION_CACHE_DIR); repeat
+    # runs skip recompiles entirely
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
+
+
+# auto superstep cap: past ~32 steps per dispatch the per-dispatch
+# overhead is already amortised to noise and longer scans only delay
+# log/fence boundaries (bench.py --dispatch-sweep measures the curve)
+SUPERSTEP_CAP = 32
+
+
+def resolve_steps_per_dispatch(cfg: TrainConfig) -> int:
+    """Resolve/validate ``--steps-per-dispatch`` to the concrete superstep
+    length ``k`` for this run.
+
+    The train loop only fences and logs at superstep edges, so ``k`` must
+    divide ``--log-every`` and ``--ckpt-every-steps`` (when enabled) —
+    boundaries then land exactly on superstep edges and the logged
+    loss/step stream is indistinguishable from per-step dispatch. An
+    explicit ``k`` violating that is a config error, as is ``k > 1``
+    combined with ``--fail-at`` (fault-injection timing is defined in
+    per-step terms; a k-step scan would glide past the injection point).
+
+    Auto (``0``) picks 1 under ``--log-every 1``, profiling, or fault
+    injection (each wants true per-step dispatch), else the largest
+    divisor of the log/ckpt intervals ≤ :data:`SUPERSTEP_CAP`. The
+    epoch's trailing partial superstep is NOT a config concern: it runs
+    at its true length via a second compiled shape
+    (engine.make_superstep).
+    """
+    k = cfg.steps_per_dispatch
+    if k < 0:
+        raise ValueError(
+            f"--steps-per-dispatch must be >= 1 (or 0 = auto), got {k}")
+    if k == 0:
+        if cfg.profile_dir or cfg.fail_at is not None or cfg.log_every == 1:
+            return 1
+        cap = SUPERSTEP_CAP if cfg.log_every <= 0 else min(cfg.log_every,
+                                                           SUPERSTEP_CAP)
+        best = 1
+        for d in range(1, cap + 1):
+            if cfg.log_every > 0 and cfg.log_every % d:
+                continue
+            if cfg.ckpt_every_steps and cfg.ckpt_every_steps % d:
+                continue
+            best = d
+        return best
+    if k > 1:
+        if cfg.fail_at is not None:
+            raise ValueError(
+                f"--steps-per-dispatch {k} with --fail-at: fault injection "
+                f"must observe per-step/epoch boundaries; use "
+                f"--steps-per-dispatch 1")
+        if cfg.log_every > 0 and cfg.log_every % k:
+            raise ValueError(
+                f"--steps-per-dispatch {k} must divide --log-every "
+                f"{cfg.log_every} so logging boundaries land on superstep "
+                f"edges")
+        if cfg.ckpt_every_steps and cfg.ckpt_every_steps % k:
+            raise ValueError(
+                f"--steps-per-dispatch {k} must divide --ckpt-every-steps "
+                f"{cfg.ckpt_every_steps} so checkpoint boundaries land on "
+                f"superstep edges")
+    return k
 
 
 def flagship_model_config(max_seq_len: int = 512) -> ModelConfig:
@@ -195,6 +262,17 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="fault injection: fail after this epoch (replaces the "
                         "reference's commented-out sys.exit(1), train.py:129)")
     p.add_argument("--log-every", type=int, default=100)
+    p.add_argument("--steps-per-dispatch", type=int, default=0,
+                   help="superstep length: compile k train steps into one "
+                        "lax.scan dispatch (one host fence per k steps). "
+                        "0 = auto: largest divisor of --log-every/"
+                        "--ckpt-every-steps up to 32, or 1 under "
+                        "profiling/--fail-at/--log-every 1")
+    p.add_argument("--compilation-cache-dir", type=str,
+                   default=None,
+                   help="persistent XLA compilation cache directory "
+                        "(default: $TPUDIST_COMPILATION_CACHE_DIR); repeat "
+                        "runs reuse compiled programs instead of retracing")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write jax.profiler traces (tensorboard format) "
                         "here; the reference had no profiling at all "
@@ -222,6 +300,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         fail_at=args.fail_at,
         log_every=args.log_every,
         profile_dir=args.profile_dir,
+        steps_per_dispatch=args.steps_per_dispatch,
+        compilation_cache_dir=args.compilation_cache_dir,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

@@ -37,6 +37,7 @@ from jax.sharding import PartitionSpec as P
 from tpudist.config import TrainConfig
 from tpudist.models import get_model
 from tpudist.parallel import sharding as shd
+from tpudist.utils import compat
 
 
 class TrainState(NamedTuple):
@@ -413,22 +414,26 @@ def _microbatch(loss_fn, params, batch, n_accum: int):
     return loss * inv, jax.tree.map(lambda g: g * inv, grads)
 
 
-def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
-    """Build the compiled train step: (TrainState, batch) -> (TrainState, loss).
+def _build_step_body(cfg: TrainConfig, mesh: Mesh):
+    """The shared single-step body behind :func:`make_train_step` and
+    :func:`make_superstep`: ``(TrainState, batch) -> (TrainState, loss)``.
 
-    Chooses the explicit-psum shard_map path for pure-DP meshes, else the
-    jit+shardings path. Loss returned is the global mean.
+    Returns ``(body, dp, st_sh)``: ``dp`` True selects the explicit-psum
+    shard_map path (pure-DP meshes — the body then contains the visible
+    gradient all-reduce and must trace inside a fully-manual shard_map);
+    otherwise the body carries the jit+shardings path's constraint
+    annotations and ``st_sh`` holds the TrainState's NamedShardings.
     """
     tx = make_optimizer(cfg)
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
     pure_dp = all(axis_sizes.get(a, 1) == 1
                   for a in ("pipe", "fsdp", "expert", "tensor", "context"))
+    dp = pure_dp and axis_sizes["data"] > 1
     # the logits constraint belongs to the jit+shardings path only — inside
     # the shard_map DP body every mesh axis is manual and a NamedSharding
     # constraint is rejected at trace time
-    loss_fn = make_loss_fn(cfg, mesh,
-                           constrain_logits=not (pure_dp
-                                                 and axis_sizes["data"] > 1))
+    loss_fn = make_loss_fn(cfg, mesh, constrain_logits=not dp)
+    st_sh = None if dp else state_shardings(cfg, mesh)
 
     def sgd_update(state: TrainState, loss, grads):
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -436,9 +441,8 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
         return TrainState(step=state.step + 1, params=new_params,
                           opt_state=new_opt), loss
 
-    if pure_dp and axis_sizes["data"] > 1:
-        # --- DP path: shard_map with explicit gradient all-reduce ---
-        def spmd_body(state: TrainState, batch):
+    if dp:
+        def body(state: TrainState, batch):
             loss, grads = _microbatch(loss_fn, state.params, batch,
                                       cfg.grad_accum_steps)
             # THE collective under test: gradient all-reduce over ICI/DCN
@@ -447,48 +451,112 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
             grads = lax.pmean(grads, "data")
             loss = lax.pmean(loss, "data")
             return sgd_update(state, loss, grads)
+    else:
+        def body(state: TrainState, batch):
+            # Pin the weights to their layout *inside* the traced body: the
+            # transpose of a sharding constraint constrains the cotangent,
+            # so the scan-transpose gradient accumulation of the stacked
+            # layer weights keeps the params' sharding instead of letting
+            # the partitioner pick one it then can't reconcile
+            # (spmd_partitioner "involuntary full rematerialization" on the
+            # grad add_any).
+            params = jax.lax.with_sharding_constraint(state.params,
+                                                      st_sh.params)
+            loss, grads = _microbatch(loss_fn, params, batch,
+                                      cfg.grad_accum_steps)
+            grads = jax.lax.with_sharding_constraint(grads, st_sh.params)
+            return sgd_update(state, loss, grads)
+    return body, dp, st_sh
 
+
+def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
+    """Build the compiled train step: (TrainState, batch) -> (TrainState, loss).
+
+    Chooses the explicit-psum shard_map path for pure-DP meshes, else the
+    jit+shardings path. Loss returned is the global mean.
+    """
+    body, dp, st_sh = _build_step_body(cfg, mesh)
+
+    if dp:
+        # --- DP path: shard_map with explicit gradient all-reduce ---
         def jitted(state, batch):
             # batch specs are built per-leaf (x is 2-D, labels are 1-D);
             # re-wrapping per trace is free — jit caches by structure.
             bspecs = jax.tree.map(lambda x: shd.batch_spec(x.ndim), batch)
-            spmd = jax.shard_map(spmd_body, mesh=mesh,
-                                 in_specs=(P(), bspecs),
-                                 out_specs=(P(), P()), check_vma=False)
+            spmd = compat.shard_map(body, mesh=mesh,
+                                    in_specs=(P(), bspecs),
+                                    out_specs=(P(), P()), check_vma=False)
             return spmd(state, batch)
         # donate the incoming state like the general path does: the update
         # writes in place instead of carrying two copies of params+opt
         # state per step
         jitted = jax.jit(jitted, donate_argnums=(0,))
-
-        def step(state, batch):
-            return jitted(state, shd.put_batch(mesh, batch))
-        return step
-
-    # --- general path: jit + sharding annotations, XLA inserts collectives ---
-    st_sh = state_shardings(cfg, mesh)
-
-    def body(state: TrainState, batch):
-        # Pin the weights to their layout *inside* the traced body: the
-        # transpose of a sharding constraint constrains the cotangent, so
-        # the scan-transpose gradient accumulation of the stacked layer
-        # weights keeps the params' sharding instead of letting the
-        # partitioner pick one it then can't reconcile (spmd_partitioner
-        # "involuntary full rematerialization" on the grad add_any).
-        params = jax.lax.with_sharding_constraint(state.params,
-                                                  st_sh.params)
-        loss, grads = _microbatch(loss_fn, params, batch,
-                                  cfg.grad_accum_steps)
-        grads = jax.lax.with_sharding_constraint(grads, st_sh.params)
-        return sgd_update(state, loss, grads)
-
-    jitted = jax.jit(body, in_shardings=(st_sh, None),
-                     out_shardings=(st_sh, NamedSharding(mesh, P())),
-                     donate_argnums=(0,))
+    else:
+        # --- general path: jit + shardings, XLA inserts collectives ---
+        jitted = jax.jit(body, in_shardings=(st_sh, None),
+                         out_shardings=(st_sh, NamedSharding(mesh, P())),
+                         donate_argnums=(0,))
 
     def step(state, batch):
         return jitted(state, shd.put_batch(mesh, batch))
     return step
+
+
+def make_superstep(cfg: TrainConfig, mesh: Mesh, k: int) -> Callable:
+    """Compiled multi-step "superstep" dispatch:
+    ``(TrainState, total, slab) -> (TrainState, total, per_step_losses)``.
+
+    Wraps the same per-step body as :func:`make_train_step` in a
+    ``lax.scan`` over the slab's leading (step) axis — ONE host dispatch
+    and ONE fence per ``k`` steps instead of ``k`` of each, which is the
+    whole game for the paper's deliberately dispatch-bound workload. The
+    slab is a device-resident ``(k, local_batch, ...)`` pytree (stage it
+    with ``sharding.put_epoch``; the train loop stages the entire epoch in
+    device memory once). State is donated across the scan exactly as in
+    the per-step paths.
+
+    The carried ``total`` accumulates each step's global-mean loss in step
+    order (``((total+l0)+l1)+…``), so the epoch's running loss sum — and
+    the stdout ``Avg loss`` — stays bitwise-identical to the per-step
+    loop's host-side accumulation. Per-step losses come back as a
+    ``k``-vector for boundary logging.
+
+    ``k`` is the nominal superstep length (shape-validated by the train
+    loop's boundary alignment, config.resolve_steps_per_dispatch); the
+    compiled program takes its scan length from the slab itself, so the
+    epoch's shorter final slab simply compiles a second shape.
+    """
+    if k < 1:
+        raise ValueError(f"superstep length must be >= 1, got {k}")
+    body, dp, st_sh = _build_step_body(cfg, mesh)
+
+    def scan_body(carry, batch):
+        state, total = carry
+        state, loss = body(state, batch)
+        return (state, total + loss), loss
+
+    def super_body(state, total, slab):
+        (state, total), losses = lax.scan(scan_body, (state, total), slab)
+        return state, total, losses
+
+    if dp:
+        def jitted(state, total, slab):
+            sspecs = jax.tree.map(lambda x: shd.epoch_spec(x.ndim), slab)
+            spmd = compat.shard_map(super_body, mesh=mesh,
+                                    in_specs=(P(), P(), sspecs),
+                                    out_specs=(P(), P(), P()),
+                                    check_vma=False)
+            return spmd(state, total, slab)
+        jitted = jax.jit(jitted, donate_argnums=(0, 1))
+    else:
+        rep = NamedSharding(mesh, P())
+        jitted = jax.jit(super_body, in_shardings=(st_sh, rep, None),
+                         out_shardings=(st_sh, rep, rep),
+                         donate_argnums=(0, 1))
+
+    def superstep(state, total, slab):
+        return jitted(state, total, slab)
+    return superstep
 
 
 def make_eval_fn(cfg: TrainConfig, mesh: Mesh) -> Callable:

@@ -72,6 +72,15 @@ class StepTimer:
             self.steps += n
         return dt
 
+    def split(self) -> Dict[str, Any]:
+        """Compile-vs-run wall split for the metrics stream: the warmup
+        fence group absorbs trace+compile (near-zero when the persistent
+        compilation cache hits — the pair makes cache effectiveness and
+        steady-state dispatch separately visible), ``run_s`` covers the
+        counted steady-state steps."""
+        return {"compile_warmup_s": round(self.warmup_s, 3),
+                "run_s": round(self.elapsed, 3), "steps": self.steps}
+
     def steps_per_sec(self) -> float:
         return self.steps / self.elapsed if self.elapsed > 0 else 0.0
 

@@ -464,27 +464,34 @@ def loss_fn(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                      logits_sharding=logits_sharding)
 
 
-def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int):
+def cp_attention(impl: str, axis: str, n_ctx: int, s_local: int,
+                 rank=None):
     """Per-shard attention impl + RoPE position info for a context-
     parallel body. Returns (attn_fn, rope_positions, rope_offset) —
     exactly one of positions/offset is meaningful (zigzag shards hold two
     non-adjacent chunks; ulysses shards are contiguous). Shared by the
-    transformer and MoE cp loss builders."""
+    transformer and MoE cp loss builders.
+
+    ``rank`` is this shard's index on ``axis``, passed in by the cp
+    scaffolding as a sharded-iota input: deriving it via
+    ``lax.axis_index`` inside the partially-manual cp shard_map lowers to
+    a PartitionId instruction old jax's SPMD partitioner rejects."""
+    me = lax.axis_index(axis) if rank is None else rank
     if impl == "ring":
         from tpudist.ops.ring_attention import (ring_attention_local,
                                                 zigzag_positions)
-        pos = zigzag_positions(lax.axis_index(axis), s_local, n_ctx)
+        pos = zigzag_positions(me, s_local, n_ctx)
 
         def attn(q, k, v):
             return ring_attention_local(q, k, v, axis, causal=True,
-                                        layout="zigzag")
+                                        layout="zigzag", rank=me)
         return attn, pos, 0
     if impl == "ulysses":
         from tpudist.ops.ulysses import ulysses_attention
 
         def attn(q, k, v):
             return ulysses_attention(q, k, v, axis)
-        return attn, None, lax.axis_index(axis) * s_local
+        return attn, None, me * s_local
     raise ValueError(f"unknown cp impl {impl!r}: {' | '.join(CP_IMPLS)}")
 
 
@@ -503,6 +510,8 @@ def make_cp_loss(mesh, shard_loss_fn, *, axis: str = "context",
     """
     if impl not in CP_IMPLS:
         raise ValueError(f"unknown cp impl {impl!r}: {' | '.join(CP_IMPLS)}")
+    from tpudist.utils import compat
+    compat.check_partial_auto(mesh, axis, "context parallelism")
     n_ctx = mesh.shape[axis]
 
     def loss(params, tokens: jax.Array) -> jax.Array:
@@ -512,17 +521,20 @@ def make_cp_loss(mesh, shard_loss_fn, *, axis: str = "context",
             inputs = zigzag_permute(inputs, n_ctx)
             targets = zigzag_permute(targets, n_ctx)
 
-        def body(params, inputs, targets):
+        def body(params, inputs, targets, ranks):
+            # ranks is a sharded iota: each shard sees its own index as a
+            # (1,)-slice — the partial-auto-safe spelling of axis_index
             attn, pos, off = cp_attention(impl, axis, n_ctx,
-                                          inputs.shape[1])
+                                          inputs.shape[1], rank=ranks[0])
             local = shard_loss_fn(params, inputs, targets, attn, pos, off)
             return lax.pmean(local, axis)
 
-        return jax.shard_map(
+        return compat.shard_map(
             body, mesh=mesh,
-            in_specs=(P(), P(None, axis), P(None, axis)),
+            in_specs=(P(), P(None, axis), P(None, axis), P(axis)),
             out_specs=P(), axis_names=frozenset({axis}),
-            check_vma=False)(params, inputs, targets)
+            check_vma=False)(params, inputs, targets,
+                             jnp.arange(n_ctx, dtype=jnp.int32))
     return loss
 
 

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpudist.utils import compat
+
 NEG = -1e30
 
 # The kernels' working set (double-buffered q/k/v/out blocks + f32
@@ -52,7 +54,7 @@ NEG = -1e30
 # they compile whether or not the process set
 # --xla_tpu_scoped_vmem_limit_kib (tpudist.utils.tune_tpu); v5e VMEM is
 # 128 MiB total.
-_COMPILER_PARAMS = pltpu.CompilerParams(
+_COMPILER_PARAMS = compat.tpu_compiler_params(
     dimension_semantics=("parallel", "arbitrary", "arbitrary"),
     vmem_limit_bytes=100 * 1024 * 1024,
 )
@@ -499,7 +501,7 @@ def _bwd(scale, block_b, block_q, block_k, causal, interpret, res, ct):
                 jax.ShapeDtypeStruct((bkv, sk, d), v.dtype),
             ],
             interpret=interpret,
-            compiler_params=pltpu.CompilerParams(
+            compiler_params=compat.tpu_compiler_params(
                 dimension_semantics=("parallel",),
                 vmem_limit_bytes=100 * 1024 * 1024),
         )(*args1)

@@ -43,6 +43,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from tpudist.utils import compat
+
 NEG = -1e30
 
 # Self-contained VMEM budget (see flash_attention._COMPILER_PARAMS): the
@@ -51,7 +53,7 @@ NEG = -1e30
 # re-streams the full (tokens, d) h (dE pass) or (vocab, d) embedding
 # (fwd/dh passes) through HBM: at the pre-tune block_t=256 that re-read
 # traffic alone was ~15 GB (≈18 ms) per kernel at bench shapes.
-_COMPILER_PARAMS = pltpu.CompilerParams(
+_COMPILER_PARAMS = compat.tpu_compiler_params(
     dimension_semantics=("parallel", "arbitrary"),
     vmem_limit_bytes=100 * 1024 * 1024,
 )

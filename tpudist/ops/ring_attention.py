@@ -62,6 +62,8 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from tpudist.utils import compat
+
 NEG = -1e30
 
 
@@ -167,7 +169,8 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
                          axis: str, *, causal: bool = True,
                          layout: str = "zigzag",
                          unroll: int | bool = False,
-                         use_flash: bool | None = None) -> jax.Array:
+                         use_flash: bool | None = None,
+                         rank=None) -> jax.Array:
     """Per-shard ring attention; call INSIDE shard_map.
 
     q: local block ``(batch, s_local, heads, head_dim)``; k, v may have
@@ -180,10 +183,17 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
     ``use_flash``: None = auto (flash kernel hops on TPU when the chunk
     shapes qualify, einsum otherwise); True forces the kernel (raising if
     the shapes don't qualify); False forces the einsum reference path.
+
+    ``rank``: this shard's index on ``axis``. None = derive via
+    ``lax.axis_index``, which is correct whenever it lowers — but under a
+    PARTIALLY-manual shard_map on old jax the SPMD partitioner rejects
+    the resulting PartitionId instruction, so partial-auto callers (the
+    context-parallel loss builders) pass the rank in as a sharded-iota
+    input instead (see models.transformer.make_cp_loss).
     """
     if layout not in ("zigzag", "contig"):
         raise ValueError(f"unknown ring layout {layout!r}")
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     if use_flash is None:
         use_flash = _auto_use_flash(q.shape, k.shape, layout, causal, n)
     elif use_flash and not flash_hops_supported(q.shape, k.shape,
@@ -200,14 +210,17 @@ def ring_attention_local(q: jax.Array, k: jax.Array, v: jax.Array,
         if use_flash:
             o, _ = _flash_chunk(q, k, v, causal=causal)
             return o.astype(q.dtype)
-        return _ring_contig(q, k, v, axis, causal=causal, unroll=unroll)
+        return _ring_contig(q, k, v, axis, causal=causal, unroll=unroll,
+                            rank=rank)
     if layout == "zigzag" and causal:
         if use_flash:
-            return _ring_zigzag_flash(q, k, v, axis, unroll=unroll)
-        return _ring_zigzag(q, k, v, axis, unroll=unroll)
+            return _ring_zigzag_flash(q, k, v, axis, unroll=unroll,
+                                      rank=rank)
+        return _ring_zigzag(q, k, v, axis, unroll=unroll, rank=rank)
     if use_flash and not causal:
         return _ring_contig_flash(q, k, v, axis, unroll=unroll)
-    return _ring_contig(q, k, v, axis, causal=causal, unroll=unroll)
+    return _ring_contig(q, k, v, axis, causal=causal, unroll=unroll,
+                        rank=rank)
 
 
 def _expand_gqa(x: jax.Array, rep: int) -> jax.Array:
@@ -230,7 +243,7 @@ def _ring_sweep(k, v, axis: str, state, consume, *, start: int,
     local block inside the sweep (contig); ``start=1`` expects the
     caller to have consumed it already (zigzag local specialisation)
     and begins with one rotation."""
-    n = lax.axis_size(axis)
+    n = compat.axis_size(axis)
     perm = [(j, (j + 1) % n) for j in range(n)]
     if start:
         k = lax.ppermute(k, axis, perm=perm)
@@ -248,11 +261,11 @@ def _ring_sweep(k, v, axis: str, state, consume, *, start: int,
 
 
 def _ring_contig(q, k, v, axis: str, *, causal: bool,
-                 unroll: int | bool = False) -> jax.Array:
+                 unroll: int | bool = False, rank=None) -> jax.Array:
     """Contiguous-shard ring: every rank consumes every kv block (the only
     option without causality; under causality prefer zigzag)."""
-    n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
+    n = compat.axis_size(axis)
+    me = lax.axis_index(axis) if rank is None else rank
     b, s, h, d = q.shape
     rep = h // k.shape[2]
     scale = 1.0 / jnp.sqrt(jnp.asarray(d, jnp.float32))
@@ -280,10 +293,10 @@ def _ring_contig(q, k, v, axis: str, *, causal: bool,
 
 
 def _ring_zigzag(q, k, v, axis: str, *,
-                 unroll: int | bool = False) -> jax.Array:
+                 unroll: int | bool = False, rank=None) -> jax.Array:
     """Zigzag-layout causal ring (see module docstring for the schedule)."""
-    n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
+    n = compat.axis_size(axis)
+    me = lax.axis_index(axis) if rank is None else rank
     b, s, h, d = q.shape
     if s % 2:
         raise ValueError("zigzag layout needs an even local sequence length")
@@ -381,7 +394,7 @@ def merge_partials(o_a, lse_a, o_b, lse_b):
 
 
 def _ring_zigzag_flash(q, k, v, axis: str, *,
-                       unroll: int | bool = False) -> jax.Array:
+                       unroll: int | bool = False, rank=None) -> jax.Array:
     """Zigzag causal ring with every hop in the flash kernel.
 
     Same schedule as :func:`_ring_zigzag` (see module docstring); the
@@ -392,8 +405,8 @@ def _ring_zigzag_flash(q, k, v, axis: str, *,
     causal mask is exactly the zigzag local mask (lo×lo triangle, hi×lo
     full, lo×hi masked, hi×hi triangle). Remote hops are the two fully
     unmasked chunk calls of the zigzag schedule."""
-    n = lax.axis_size(axis)
-    me = lax.axis_index(axis)
+    n = compat.axis_size(axis)
+    me = lax.axis_index(axis) if rank is None else rank
     b, s, h, d = q.shape
     if s % 2:
         raise ValueError("zigzag layout needs an even local sequence length")
@@ -464,7 +477,8 @@ def make_ring_attention(mesh: Mesh, axis: str = "context", *,
     spec = P(None, axis, None, None)
     zig = layout == "zigzag" and causal and n > 1
 
-    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(spec, spec, spec),
+    @functools.partial(compat.shard_map, mesh=mesh,
+                       in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     def f(q, k, v):
         return ring_attention_local(q, k, v, axis, causal=causal,

@@ -56,6 +56,35 @@ def batch_spec(ndim: int) -> P:
     return P(("data", "fsdp"), *([None] * (ndim - 1)))
 
 
+def epoch_spec(ndim: int) -> P:
+    """Spec for epoch/superstep slabs shaped ``(steps, local_batch, ...)``:
+    dim 0 is the step axis (unsharded — every device sees the full step
+    range; ``lax.scan`` consumes it), the batch dim rides data+fsdp as in
+    :func:`batch_spec`."""
+    return P(None, ("data", "fsdp"), *([None] * (ndim - 2)))
+
+
+def put_epoch(mesh: Mesh, batches):
+    """Stage a whole epoch's ``(steps, local_batch, ...)`` arrays into
+    device memory (HBM on TPU), sharded batch-wise per :func:`epoch_spec`.
+
+    One async host→device transfer per epoch replaces a per-step
+    ``put_batch``: ``device_put`` returns immediately, so the transfer
+    overlaps whatever compute is already enqueued, and every superstep's
+    slab is then an on-device slice — no host fence on the hot path.
+    Multi-process follows :func:`put_batch`'s contract: each host owns a
+    distinct batch-dim slice of every global step.
+    """
+    import numpy as np
+
+    def _put(x):
+        sh = NamedSharding(mesh, epoch_spec(np.ndim(x)))
+        if jax.process_count() == 1:
+            return jax.device_put(x, sh)
+        return jax.make_array_from_process_local_data(sh, np.asarray(x))
+    return jax.tree.map(_put, batches)
+
+
 def batch_sharding(mesh: Mesh, tree):
     return jax.tree.map(
         lambda x: NamedSharding(mesh, batch_spec(x.ndim)), tree)

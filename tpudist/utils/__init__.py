@@ -1,3 +1,5 @@
-from tpudist.utils.platform import maybe_force_platform, tune_tpu
+from tpudist.utils.platform import (maybe_enable_compilation_cache,
+                                    maybe_force_platform, tune_tpu)
 
-__all__ = ["maybe_force_platform", "tune_tpu"]
+__all__ = ["maybe_enable_compilation_cache", "maybe_force_platform",
+           "tune_tpu"]

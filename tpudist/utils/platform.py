@@ -18,6 +18,33 @@ def maybe_force_platform() -> None:
         jax.config.update("jax_platforms", force)
 
 
+def maybe_enable_compilation_cache(cache_dir: str | None = None) -> None:
+    """Opt-in persistent XLA compilation cache.
+
+    ``--compilation-cache-dir`` / ``TPUDIST_COMPILATION_CACHE_DIR`` point
+    jax's persistent cache at a directory that survives the process, so a
+    repeat run (CI re-run, restarted worker) loads compiled programs
+    instead of recompiling — the startup cost the superstep path cannot
+    amortise away. The min-compile-time/min-entry-size floors drop to 0:
+    the acceptance workload's programs are deliberately tiny, and the
+    default floors would skip caching exactly the programs this workload
+    compiles.
+    """
+    d = cache_dir or os.environ.get("TPUDIST_COMPILATION_CACHE_DIR")
+    if not d:
+        return
+    import jax
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0),
+                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass  # knob names drift across jax versions; the cache dir
+            # alone still caches everything past the default floors
+
+
 def tune_tpu(scoped_vmem_kib: int | None = None) -> None:
     """Set performance-tuning libtpu flags; call before first backend use.
 
