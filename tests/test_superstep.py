@@ -61,20 +61,26 @@ def _run_per_step(cfg, mesh, batches, n_steps):
     return state, np.asarray(losses), float(total)
 
 
-def _run_superstep(cfg, mesh, batches, n_steps, k):
+def _run_superstep(cfg, mesh, batches, n_steps, k, first=0):
+    """Drive the padded single-compile superstep contract: the epoch is
+    zero-padded to a k-multiple, every dispatch consumes an exact k-slab,
+    and [lo, hi) masks the pad tail / pre-resume steps."""
     state = engine.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
     superstep = engine.make_superstep(cfg, mesh, k)
-    staged = shd.put_epoch(mesh, batches)
+    padded = -(-n_steps // k) * k
+    staged = shd.put_epoch(mesh, data.pad_steps(batches, padded))
     total = jnp.zeros((), jnp.float32)
     losses = []
-    i = 0
-    while i < n_steps:
-        end = min(n_steps, i + k)
-        slab = jax.tree.map(lambda a: a[i:end], staged)
-        state, total, step_losses = superstep(state, total, slab)
-        losses.extend(np.asarray(step_losses))
-        i = end
-    return state, np.asarray(losses), float(total)
+    for j in range(padded // k):
+        gstart = j * k
+        if gstart + k <= first or gstart >= n_steps:
+            continue
+        lo = max(first - gstart, 0)
+        hi = min(n_steps - gstart, k)
+        slab = jax.tree.map(lambda a: a[gstart:gstart + k], staged)
+        state, total, step_losses = superstep(state, total, slab, lo, hi)
+        losses.extend(np.asarray(step_losses)[lo:hi])
+    return state, np.asarray(losses), float(total), superstep
 
 
 def _assert_bitwise_equal(state_a, state_b, losses_a, losses_b,
@@ -103,18 +109,36 @@ def test_superstep_k4_bitwise_matches_per_step(model, n_dev, devices8):
     _assert_bitwise_equal(got[0], ref[0], got[1], ref[1], got[2], ref[2])
 
 
-def test_superstep_partial_tail_runs_true_length(devices8):
-    """n_steps not a k-multiple: the trailing slab runs at its true length
-    (a second compiled shape), and the trajectory still matches per-step
-    bitwise."""
+def test_superstep_partial_tail_single_compile(devices8):
+    """n_steps not a k-multiple: the trailing slab is zero-padded to k
+    with the pad steps masked out — the trajectory matches per-step
+    bitwise AND the whole epoch (trailing partial included) runs on ONE
+    compiled program (PR 1 compiled a second shape for the tail)."""
     cfg = _cfg("mlp", parallel=ParallelConfig(data=4))
     mesh = build_mesh(cfg.parallel, devices=devices8[:4])
-    n_steps = 10                       # slabs of 4, 4, 2
+    n_steps = 10                       # k-slabs of 4, 4, 4(pad 2, hi=2)
     batches = _epoch(cfg, n_steps)
     ref = _run_per_step(cfg, mesh, batches, n_steps)
     got = _run_superstep(cfg, mesh, batches, n_steps, k=4)
     _assert_bitwise_equal(got[0], ref[0], got[1], ref[1], got[2], ref[2])
     assert len(got[1]) == n_steps
+    assert len(got[3].traces) == 1, \
+        f"trailing partial slab recompiled: {len(got[3].traces)} traces"
+
+
+def test_superstep_resume_realign_masks_leading_steps(devices8):
+    """Mid-epoch resume off the k-grid: the realignment superstep masks
+    the pre-resume steps (lo > 0) and the post-resume trajectory matches
+    a per-step run over the same step range — still one compilation."""
+    cfg = _cfg("mlp")
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    n_steps, k, first = 10, 4, 2
+    batches = _epoch(cfg, n_steps)
+    sub = jax.tree.map(lambda a: a[first:], batches)
+    ref = _run_per_step(cfg, mesh, sub, n_steps - first)
+    got = _run_superstep(cfg, mesh, batches, n_steps, k=k, first=first)
+    _assert_bitwise_equal(got[0], ref[0], got[1], ref[1], got[2], ref[2])
+    assert len(got[3].traces) == 1
 
 
 def test_make_superstep_rejects_bad_k(devices8):

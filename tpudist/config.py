@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import dataclasses
+import os
 from dataclasses import dataclass
 from typing import Optional, Sequence
 
@@ -105,6 +106,11 @@ class TrainConfig:
     compilation_cache_dir: Optional[str] = None  # persistent XLA
     # compilation cache (also via TPUDIST_COMPILATION_CACHE_DIR); repeat
     # runs skip recompiles entirely
+    staging_budget_mb: Optional[float] = None  # per-device MB of batch
+    # staging memory (sharding.plan_slabs). None = $TPUDIST_STAGING_BUDGET_MB,
+    # else auto from device memory stats minus the train-state estimate
+    # (resolve_staging_budget_bytes); epochs over budget stream in
+    # double-buffered slabs instead of staging whole
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -131,8 +137,9 @@ def resolve_steps_per_dispatch(cfg: TrainConfig) -> int:
     Auto (``0``) picks 1 under ``--log-every 1``, profiling, or fault
     injection (each wants true per-step dispatch), else the largest
     divisor of the log/ckpt intervals ≤ :data:`SUPERSTEP_CAP`. The
-    epoch's trailing partial superstep is NOT a config concern: it runs
-    at its true length via a second compiled shape
+    epoch's trailing partial superstep is NOT a config concern: it is
+    zero-padded to ``k`` with the pad steps masked out of the loss and
+    state updates, so ONE compiled program serves the whole run
     (engine.make_superstep).
     """
     k = cfg.steps_per_dispatch
@@ -169,6 +176,48 @@ def resolve_steps_per_dispatch(cfg: TrainConfig) -> int:
                 f"{cfg.ckpt_every_steps} so checkpoint boundaries land on "
                 f"superstep edges")
     return k
+
+
+# Auto staging budget: leave the train state (params + opt moments) plus
+# this multiple of it for grads / activations / XLA workspace, then stage
+# batches into half of what remains (the other half is slack for the
+# allocator — device memory stats are an estimate, not a reservation).
+# The floor keeps the budget positive when the conservative 4x estimate
+# exceeds the device estimate: a zero budget would make plan_slabs
+# reject EVERY epoch, failing runs that used to stage fine.
+STAGING_STATE_HEADROOM = 4.0
+STAGING_FREE_FRACTION = 0.5
+STAGING_FLOOR_FRACTION = 0.05
+
+
+def resolve_staging_budget_bytes(cfg: TrainConfig, *, state_bytes: int = 0,
+                                 hbm_bytes: Optional[float] = None
+                                 ) -> Optional[int]:
+    """Resolve ``--staging-budget-mb`` to a per-device byte budget for
+    epoch staging (``sharding.plan_slabs``), or ``None`` for "unbounded"
+    (always the full-epoch fast path).
+
+    Precedence: explicit flag > ``TPUDIST_STAGING_BUDGET_MB`` > auto.
+    Auto derives from the device's reported memory minus a conservative
+    train-state multiple — on backends that report no limit (CPU tests)
+    the 16 GB default makes small epochs take the fast path, which is
+    exactly the seed behavior.
+    """
+    mb = cfg.staging_budget_mb
+    if mb is None:
+        env = os.environ.get("TPUDIST_STAGING_BUDGET_MB")
+        if env:
+            mb = float(env)
+    if mb is not None:
+        if mb <= 0:
+            raise ValueError(
+                f"--staging-budget-mb must be > 0, got {mb}")
+        return int(mb * 2**20)
+    if hbm_bytes is None:
+        return None
+    free = max(hbm_bytes - STAGING_STATE_HEADROOM * state_bytes,
+               hbm_bytes * STAGING_FLOOR_FRACTION)
+    return int(free * STAGING_FREE_FRACTION)
 
 
 def flagship_model_config(max_seq_len: int = 512) -> ModelConfig:
@@ -268,6 +317,13 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                         "0 = auto: largest divisor of --log-every/"
                         "--ckpt-every-steps up to 32, or 1 under "
                         "profiling/--fail-at/--log-every 1")
+    p.add_argument("--staging-budget-mb", type=float, default=None,
+                   help="per-device MB of device memory for staging epoch "
+                        "batches; epochs over budget stream in "
+                        "double-buffered k-step slabs overlapped with "
+                        "compute (default: $TPUDIST_STAGING_BUDGET_MB, "
+                        "else auto from device memory stats minus the "
+                        "params/opt-state estimate)")
     p.add_argument("--compilation-cache-dir", type=str,
                    default=None,
                    help="persistent XLA compilation cache directory "
@@ -302,6 +358,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         profile_dir=args.profile_dir,
         steps_per_dispatch=args.steps_per_dispatch,
         compilation_cache_dir=args.compilation_cache_dir,
+        staging_budget_mb=args.staging_budget_mb,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

@@ -53,6 +53,88 @@ def make_synthetic_tokens(n_samples: int, seq_len: int, vocab_size: int,
     return jnp.asarray(toks)
 
 
+def pad_steps(arrays, to_steps: int):
+    """Zero-pad ``(steps, batch, ...)`` arrays along the step axis to
+    ``to_steps``. The padded steps are MASKED out of the superstep's loss
+    accumulation and state updates (engine.make_superstep's ``lo``/``hi``
+    bounds), so the pad value never reaches the trajectory — zeros keep
+    every model's forward finite (token id 0 is always in-vocab)."""
+    def pad(a):
+        a = np.asarray(a)
+        if a.shape[0] >= to_steps:
+            return a
+        fill = np.zeros((to_steps - a.shape[0],) + a.shape[1:], a.dtype)
+        return np.concatenate([a, fill], axis=0)
+    return jax.tree.map(pad, arrays)
+
+
+class EpochPlan:
+    """Lazy per-slab materialisation of one epoch's batches.
+
+    Holds the epoch's permutation (a pure function of ``(seed, epoch)``)
+    and the source arrays; ``slab(start, stop)`` gathers only that step
+    range into host ``(steps, local_batch, ...)`` arrays. This replaces
+    the one-shot whole-epoch materialisation: the streaming train loop
+    stages bounded slabs into device memory while compute runs, so epochs
+    larger than the staging budget — or than HBM — run fine.
+    """
+
+    def __init__(self, arrays, idx: np.ndarray):
+        self.arrays = tuple(np.asarray(a) for a in arrays)
+        self.idx = idx
+
+    @property
+    def n_steps(self) -> int:
+        return self.idx.shape[0]
+
+    @property
+    def bytes_per_step(self) -> int:
+        """Host bytes of one materialised ``(local_batch, ...)`` step."""
+        bs = self.idx.shape[1]
+        return sum(bs * int(np.prod(a.shape[1:], dtype=np.int64))
+                   * a.dtype.itemsize for a in self.arrays)
+
+    def slab(self, start: int, stop: int, pad_to: int = 0):
+        """Materialise steps ``[start, stop)`` as ``(steps, local_batch,
+        ...)`` host arrays, zero-padded along the step axis to ``pad_to``
+        when that exceeds the true length (see :func:`pad_steps`)."""
+        sl = self.idx[start:stop]
+        out = tuple(a[sl] for a in self.arrays)
+        if pad_to > sl.shape[0]:
+            out = pad_steps(out, pad_to)
+        return out
+
+
+def plan_epoch(arrays, *, batch_size: int, seed: int, epoch: int,
+               process_index: int = 0, process_count: int = 1) -> EpochPlan:
+    """Build this process's :class:`EpochPlan` for one epoch — the lazy
+    (slab-wise) counterpart of :func:`shard_epoch`, sharing its contract:
+    global ``batch_size``, global batch ``b`` is ``perm[b*batch_size:
+    (b+1)*batch_size]``, each process owns a contiguous ``local_batch``
+    slice of every global batch, trailing samples are dropped."""
+    n = int(np.asarray(arrays[0]).shape[0])
+    idx = _epoch_index(n, batch_size=batch_size, seed=seed, epoch=epoch,
+                       process_index=process_index,
+                       process_count=process_count)
+    return EpochPlan(arrays, idx)
+
+
+def _epoch_index(n: int, *, batch_size: int, seed: int, epoch: int,
+                 process_index: int, process_count: int) -> np.ndarray:
+    """(steps, local_batch) gather indices for this process's epoch."""
+    if batch_size % process_count:
+        raise ValueError(
+            f"global batch_size={batch_size} not divisible by "
+            f"process_count={process_count}")
+    local_bs = batch_size // process_count
+    steps = n // batch_size
+    if steps == 0:
+        raise ValueError(
+            f"n_samples={n} < global batch_size={batch_size}: zero steps")
+    perm = epoch_permutation(seed, epoch, n)[: steps * batch_size]
+    return perm.reshape(steps, process_count, local_bs)[:, process_index, :]
+
+
 def epoch_permutation(seed: int, epoch: int, n: int) -> np.ndarray:
     """Global shuffle for an epoch, identical on every process.
 
@@ -75,19 +157,10 @@ def shard_epoch(x: jax.Array, y: jax.Array, *, batch_size: int, seed: int,
     SURVEY.md §2.7). Trailing samples that don't fill a global batch are
     dropped (static shapes for XLA).
     """
-    n = x.shape[0]
-    if batch_size % process_count:
-        raise ValueError(
-            f"global batch_size={batch_size} not divisible by "
-            f"process_count={process_count}")
-    local_bs = batch_size // process_count
-    steps = n // batch_size
-    if steps == 0:
-        raise ValueError(
-            f"n_samples={n} < global batch_size={batch_size}: zero steps")
-    perm = epoch_permutation(seed, epoch, n)[: steps * batch_size]
     # Global batch b is perm[b*batch_size:(b+1)*batch_size]; this process owns
     # the contiguous slice [process_index*local_bs : (process_index+1)*local_bs)
     # of every global batch — the DistributedSampler-equivalent contract.
-    idx = perm.reshape(steps, process_count, local_bs)[:, process_index, :]
+    idx = _epoch_index(x.shape[0], batch_size=batch_size, seed=seed,
+                       epoch=epoch, process_index=process_index,
+                       process_count=process_count)
     return x[idx], y[idx]

@@ -502,60 +502,127 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     return step
 
 
+def state_bytes_per_device(state) -> int:
+    """Largest per-device byte footprint of a (possibly sharded) pytree —
+    the params/opt-state term of the staging-budget estimate
+    (config.resolve_staging_budget_bytes). Counted from each leaf's
+    addressable shards so FSDP/TP layouts report their true per-device
+    share while replicated leaves count in full."""
+    per: dict = {}
+    for leaf in jax.tree.leaves(state):
+        shards = getattr(leaf, "addressable_shards", None)
+        if shards is None:
+            n = getattr(leaf, "nbytes", 0)
+            for d in jax.local_devices():
+                per[d.id] = per.get(d.id, 0) + n // max(
+                    jax.local_device_count(), 1)
+            continue
+        for sh in shards:
+            per[sh.device.id] = per.get(sh.device.id, 0) + sh.data.nbytes
+    return max(per.values()) if per else 0
+
+
 def make_superstep(cfg: TrainConfig, mesh: Mesh, k: int) -> Callable:
     """Compiled multi-step "superstep" dispatch:
-    ``(TrainState, total, slab) -> (TrainState, total, per_step_losses)``.
+    ``(TrainState, total, slab, lo, hi) -> (TrainState, total,
+    per_step_losses)``.
 
     Wraps the same per-step body as :func:`make_train_step` in a
     ``lax.scan`` over the slab's leading (step) axis — ONE host dispatch
     and ONE fence per ``k`` steps instead of ``k`` of each, which is the
     whole game for the paper's deliberately dispatch-bound workload. The
-    slab is a device-resident ``(k, local_batch, ...)`` pytree (stage it
-    with ``sharding.put_epoch``; the train loop stages the entire epoch in
-    device memory once). State is donated across the scan exactly as in
-    the per-step paths.
+    slab is a device-resident ``(k, local_batch, ...)`` pytree (staged by
+    ``sharding.put_epoch``, whole-epoch or streamed slab-wise per
+    ``sharding.plan_slabs``).
 
-    The carried ``total`` accumulates each step's global-mean loss in step
-    order (``((total+l0)+l1)+…``), so the epoch's running loss sum — and
-    the stdout ``Avg loss`` — stays bitwise-identical to the per-step
-    loop's host-side accumulation. Per-step losses come back as a
-    ``k``-vector for boundary logging.
+    The slab's step axis is always EXACTLY ``k`` long; ``lo``/``hi``
+    bound the valid steps inside it (``lo <= idx < hi``). Steps outside
+    the bounds are MASKED out via ``lax.cond``: the skip branch passes
+    the carried state/total through untouched. ``cond`` rather than a
+    ``where``-select on the outputs because a select makes the carried
+    state a second consumer of the update arithmetic, which changes
+    XLA's fusion (FMA contraction) of the Adam update on the CPU backend
+    and costs the bitwise-parity guarantee at the ULP level (measured:
+    3/64 weights off by 1 ULP after 8 steps); ``cond`` isolates the body
+    in its own branch computation, so valid steps lower identically to
+    the unmasked scan. One compiled program then serves every slab in
+    the run — the zero-padded trailing partial superstep (``hi < k``)
+    and the mid-epoch-resume realignment slab (``lo > 0``) included —
+    where the old variable-length tail forced a second compile per
+    epoch. ``lo``/``hi`` are traced scalars, so their values never
+    recompile; ``superstep.traces`` counts actual retraces (tests and
+    ``bench.py --staging-sweep`` pin it to 1).
 
-    ``k`` is the nominal superstep length (shape-validated by the train
-    loop's boundary alignment, config.resolve_steps_per_dispatch); the
-    compiled program takes its scan length from the slab itself, so the
-    epoch's shorter final slab simply compiles a second shape.
+    Donation contract (audited for the staging pipeline): the incoming
+    ``state`` and ``total`` are donated — the update writes in place, so
+    no second copy of params+opt state sits beside the staged slabs. The
+    slab argument is deliberately NOT donated: no output of the scan
+    shares its ``(k, batch, ...)`` shape, so XLA could never alias it
+    (donation would only emit an unusable-donation warning per compile
+    and free nothing early). Slab memory is reclaimed by reference
+    death instead — each k-slice dies after its dispatch, and the
+    streaming loop drops each staged slab as soon as its last superstep
+    is dispatched, keeping at most two slabs resident.
+
+    The carried ``total`` accumulates each valid step's global-mean loss
+    in step order (``((total+l0)+l1)+…`` — the masked select returns the
+    bitwise-identical sum for valid steps), so the epoch's running loss
+    sum and the stdout ``Avg loss`` stay bitwise-identical to per-step
+    dispatch. Per-step losses come back as a ``k``-vector; entries
+    outside ``[lo, hi)`` are meaningless and must not be read.
     """
     if k < 1:
         raise ValueError(f"superstep length must be >= 1, got {k}")
     body, dp, st_sh = _build_step_body(cfg, mesh)
+    traces: list = []
 
-    def scan_body(carry, batch):
-        state, total = carry
-        state, loss = body(state, batch)
-        return (state, total + loss), loss
+    def super_body(state, total, slab, lo, hi):
+        traces.append(1)   # trace-time marker: one entry per compilation
 
-    def super_body(state, total, slab):
-        (state, total), losses = lax.scan(scan_body, (state, total), slab)
+        def scan_body(carry, xs):
+            state, total = carry
+            batch, idx = xs
+            valid = (idx >= lo) & (idx < hi)
+
+            def run(ops):
+                state, total, batch = ops
+                state, loss = body(state, batch)
+                return state, total + loss, loss
+
+            def skip(ops):
+                state, total, _ = ops
+                # emitted loss for masked steps is a placeholder; the
+                # train loop never reads outside [lo, hi)
+                return state, total, jnp.float32(0)
+
+            state, total, loss = lax.cond(valid, run, skip,
+                                          (state, total, batch))
+            return (state, total), loss
+
+        n = jax.tree.leaves(slab)[0].shape[0]
+        (state, total), losses = lax.scan(
+            scan_body, (state, total), (slab, jnp.arange(n)))
         return state, total, losses
 
     if dp:
-        def jitted(state, total, slab):
+        def jitted(state, total, slab, lo, hi):
             sspecs = jax.tree.map(lambda x: shd.epoch_spec(x.ndim), slab)
             spmd = compat.shard_map(super_body, mesh=mesh,
-                                    in_specs=(P(), P(), sspecs),
+                                    in_specs=(P(), P(), sspecs, P(), P()),
                                     out_specs=(P(), P(), P()),
                                     check_vma=False)
-            return spmd(state, total, slab)
+            return spmd(state, total, slab, lo, hi)
         jitted = jax.jit(jitted, donate_argnums=(0, 1))
     else:
         rep = NamedSharding(mesh, P())
-        jitted = jax.jit(super_body, in_shardings=(st_sh, rep, None),
+        jitted = jax.jit(super_body,
+                         in_shardings=(st_sh, rep, None, None, None),
                          out_shardings=(st_sh, rep, rep),
                          donate_argnums=(0, 1))
 
-    def superstep(state, total, slab):
-        return jitted(state, total, slab)
+    def superstep(state, total, slab, lo, hi):
+        return jitted(state, total, slab, jnp.int32(lo), jnp.int32(hi))
+    superstep.traces = traces
     return superstep
 
 

@@ -92,10 +92,19 @@ class StepTimer:
 class MetricsLogger:
     """JSONL metrics stream, rank-0 only (structured logging the reference
     lacked — its observability was stdout through SLURM log files,
-    SURVEY.md §5.5)."""
+    SURVEY.md §5.5).
+
+    Writes are BUFFERED: ``log()`` on the step path only serialises the
+    record into memory; file I/O happens at explicit ``flush()`` points
+    (the train loop flushes at epoch ends) and on ``close()``. A
+    per-record ``write()+flush()`` put filesystem latency — NFS-mounted
+    save dirs are the norm on pods — inside the step loop's timed fence
+    windows, where it read as training slowdown in ``StepTimer``.
+    """
     path: Optional[str] = None
     _fh: Optional[IO] = None
     history: List[Dict] = field(default_factory=list)
+    _buf: List[str] = field(default_factory=list)
 
     def log(self, **kv) -> None:
         if jax.process_index() != 0:
@@ -103,18 +112,86 @@ class MetricsLogger:
         rec = dict(ts=time.time(), **kv)
         self.history.append(rec)
         if self.path:
-            if self._fh is None:
-                d = os.path.dirname(self.path)
-                if d:
-                    os.makedirs(d, exist_ok=True)
-                self._fh = open(self.path, "a")
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
+            self._buf.append(json.dumps(rec))
+
+    def flush(self) -> None:
+        """Write buffered records out — called off the step path (epoch
+        ends, run end) so JSONL I/O never lands inside a timed window."""
+        if not (self.path and self._buf):
+            return
+        if self._fh is None:
+            d = os.path.dirname(self.path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            self._fh = open(self.path, "a")
+        self._fh.write("\n".join(self._buf) + "\n")
+        self._fh.flush()
+        self._buf.clear()
 
     def close(self) -> None:
+        self.flush()
         if self._fh:
             self._fh.close()
             self._fh = None
+
+
+@dataclass
+class StagingStats:
+    """Host-side accounting of the epoch staging pipeline
+    (train._superstep_epoch): how many bytes were staged, the peak
+    resident staging footprint, and how much wall time the host spent
+    BLOCKED on a slab that compute was already waiting for.
+
+    ``wait_s`` is the honest exposure metric: the streaming loop fences
+    compute at slab boundaries, so by the time it blocks on the next
+    slab's readiness the device is idle — any time spent there is
+    host→device transfer the pipeline failed to hide behind the previous
+    slab's compute. ``overlap_fraction`` folds that into one number for
+    the verdict/metrics stream: 1.0 = all steady-state H2D hidden.
+    """
+    streamed: bool = False
+    slabs: int = 0
+    staged_bytes: int = 0      # cumulative per-device H2D bytes
+    resident_bytes: int = 0
+    peak_bytes: int = 0
+    stage_host_s: float = 0.0  # host time materialising + dispatching slabs
+    wait_s: float = 0.0        # host blocked on an un-arrived slab
+
+    def note_staged(self, nbytes: int, host_s: float) -> None:
+        self.slabs += 1
+        self.staged_bytes += nbytes
+        self.resident_bytes += nbytes
+        self.peak_bytes = max(self.peak_bytes, self.resident_bytes)
+        self.stage_host_s += host_s
+
+    def note_released(self, nbytes: int) -> None:
+        self.resident_bytes = max(0, self.resident_bytes - nbytes)
+
+    def note_wait(self, slab) -> float:
+        """Block until ``slab``'s transfer lands; account the exposed
+        time. Called with the previous slab's compute already drained."""
+        t0 = time.perf_counter()
+        jax.block_until_ready(slab)
+        dt = time.perf_counter() - t0
+        self.wait_s += dt
+        return dt
+
+    def overlap_fraction(self, run_s: float) -> Optional[float]:
+        """Fraction of steady-state wall time NOT exposed to staging
+        waits; None when nothing streamed (fast path: one slab, whose
+        transfer overlaps trace+compile by construction)."""
+        if not self.streamed or run_s <= 0:
+            return None
+        return max(0.0, min(1.0, 1.0 - self.wait_s / run_s))
+
+    def split(self) -> Dict[str, Any]:
+        """Staging-vs-compute fields for the ``kind=timing`` record."""
+        return {"staging_streamed": self.streamed,
+                "staging_slabs": self.slabs,
+                "staged_bytes": self.staged_bytes,
+                "staged_bytes_peak": self.peak_bytes,
+                "stage_host_s": round(self.stage_host_s, 3),
+                "stage_wait_s": round(self.wait_s, 3)}
 
 
 def device_kind() -> str:

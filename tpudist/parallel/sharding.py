@@ -9,6 +9,9 @@ all-gathers/reduce-scatters that DeepSpeed implements by hand
 
 from __future__ import annotations
 
+import dataclasses
+from typing import Optional
+
 import jax
 from jax.sharding import Mesh, NamedSharding
 from jax.sharding import PartitionSpec as P
@@ -65,13 +68,15 @@ def epoch_spec(ndim: int) -> P:
 
 
 def put_epoch(mesh: Mesh, batches):
-    """Stage a whole epoch's ``(steps, local_batch, ...)`` arrays into
-    device memory (HBM on TPU), sharded batch-wise per :func:`epoch_spec`.
+    """Stage ``(steps, local_batch, ...)`` arrays — a whole epoch or one
+    :class:`SlabPlan` slab — into device memory (HBM on TPU), sharded
+    batch-wise per :func:`epoch_spec`.
 
-    One async host→device transfer per epoch replaces a per-step
+    One async host→device transfer per slab replaces a per-step
     ``put_batch``: ``device_put`` returns immediately, so the transfer
-    overlaps whatever compute is already enqueued, and every superstep's
-    slab is then an on-device slice — no host fence on the hot path.
+    overlaps whatever compute is already enqueued (the previous slab's
+    supersteps in the streaming loop), and every superstep's k-slice is
+    then an on-device slice — no host fence on the hot path.
     Multi-process follows :func:`put_batch`'s contract: each host owns a
     distinct batch-dim slice of every global step.
     """
@@ -83,6 +88,71 @@ def put_epoch(mesh: Mesh, batches):
             return jax.device_put(x, sh)
         return jax.make_array_from_process_local_data(sh, np.asarray(x))
     return jax.tree.map(_put, batches)
+
+
+@dataclasses.dataclass(frozen=True)
+class SlabPlan:
+    """How one epoch's batches move host→device under the staging budget.
+
+    ``slab_steps`` is the staging granularity: the train loop materialises
+    and ``device_put``s one ``(slab_steps, local_batch, ...)`` slab while
+    the previous slab's supersteps run — double-buffered, so at most two
+    slabs are resident and ``2 * slab_bytes <= budget_bytes`` by
+    construction. The fast path (``streamed=False``) is the degenerate
+    one-slab plan: the whole epoch (padded to a ``k``-multiple) stages in
+    one async transfer, exactly PR 1's behavior.
+    """
+
+    n_steps: int            # true steps in the epoch
+    k: int                  # superstep length (steps per compiled dispatch)
+    slab_steps: int         # steps per staged slab (a k-multiple)
+    n_slabs: int
+    step_bytes: int         # per-device bytes of one step's batch
+    budget_bytes: Optional[int]
+    streamed: bool
+
+    @property
+    def slab_bytes(self) -> int:
+        return self.slab_steps * self.step_bytes
+
+
+def plan_slabs(n_steps: int, k: int, step_bytes: int,
+               budget_bytes: Optional[int]) -> SlabPlan:
+    """Cut an epoch into double-buffered staging slabs under
+    ``budget_bytes`` of per-device staging memory.
+
+    * epoch fits the budget (or no budget) → the full-epoch fast path:
+      one slab, ``streamed=False``.
+    * otherwise → the largest ``k``-multiple slab with two copies inside
+      the budget (current + in-flight next).
+    * budget too small to double-buffer even one ``k``-step slab → a
+      clear config error, not a silent OOM at dispatch time.
+    """
+    if n_steps < 1:
+        raise ValueError(f"epoch must have >= 1 step, got {n_steps}")
+    if k < 1:
+        raise ValueError(f"superstep length must be >= 1, got {k}")
+    step_bytes = max(int(step_bytes), 1)
+    padded = -(-n_steps // k) * k
+    # the fast path stages the PADDED epoch, so the fit check must use
+    # it too — an epoch just under budget must stream, not stage k-1
+    # extra padded steps past the budget
+    if budget_bytes is None or padded * step_bytes <= budget_bytes:
+        return SlabPlan(n_steps, k, padded, 1, step_bytes, budget_bytes,
+                        streamed=False)
+    slab_steps = (budget_bytes // 2) // step_bytes // k * k
+    if slab_steps < k:
+        need = 2 * k * step_bytes
+        raise ValueError(
+            f"staging budget {budget_bytes / 2**20:.2f} MB cannot hold a "
+            f"double-buffered pair of k={k}-step slabs "
+            f"({need / 2**20:.2f} MB needed at "
+            f"{step_bytes / 2**20:.3f} MB/step): raise --staging-budget-mb "
+            f"or lower --steps-per-dispatch")
+    slab_steps = min(slab_steps, padded)
+    n_slabs = -(-padded // slab_steps)
+    return SlabPlan(n_steps, k, slab_steps, n_slabs, step_bytes,
+                    budget_bytes, streamed=True)
 
 
 def batch_sharding(mesh: Mesh, tree):

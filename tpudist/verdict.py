@@ -28,6 +28,26 @@ FAIL = "fail"
 # must not read as a bandwidth regression.
 UNGATEABLE = "ungateable"
 
+# Minimum steady-state staging overlap fraction (metrics.StagingStats)
+# before a streamed run is FLAGGED: below this, host→device transfer is
+# not hiding behind compute and the pod is silently input-bound.
+# Advisory, not exit-code-bearing — training that completes with slow
+# staging is a perf finding, not a correctness failure.
+STAGING_OVERLAP_MIN = float(os.environ.get("TPUDIST_STAGING_OVERLAP_MIN",
+                                           "0.5"))
+
+
+def staging_status(streamed: bool, overlap_fraction) -> str:
+    """Three-valued staging verdict for the run log + metrics stream:
+    UNGATEABLE when the epoch took the full-staging fast path (no
+    steady-state H2D to hide), else SUCCESS/FAIL by whether the measured
+    overlap fraction clears :data:`STAGING_OVERLAP_MIN` — so a pod run
+    failing to hide H2D is flagged in the artifact stream, not silently
+    slow."""
+    if not streamed or overlap_fraction is None:
+        return UNGATEABLE
+    return SUCCESS if overlap_fraction >= STAGING_OVERLAP_MIN else FAIL
+
 
 def _write(path: str, content: str) -> None:
     if path.startswith("gs://"):
