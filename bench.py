@@ -39,7 +39,6 @@ import argparse
 import glob
 import json
 import os
-import re
 import statistics
 import time
 
@@ -48,21 +47,29 @@ import jax
 from tpudist import data, engine
 from tpudist.config import (DataConfig, ModelConfig, ParallelConfig,
                             TrainConfig, flagship_model_config)
+from tpudist.obs import mfu as obs_mfu
+from tpudist.obs.hbm import HbmSampler
 
-# bf16 peak TFLOP/s by device kind (dense); None → MFU not reported
-PEAK_TFLOPS = [
-    (re.compile(r"v5 ?lite|v5e", re.I), 197.0),
-    (re.compile(r"v5p", re.I), 459.0),
-    (re.compile(r"v4", re.I), 275.0),
-    (re.compile(r"v6|trillium", re.I), 918.0),
-]
+# bf16 peak table lives in tpudist.obs.mfu now (the train run's roofline
+# record uses the same source); these aliases keep bench's surface stable
+PEAK_TFLOPS = obs_mfu.PEAK_TFLOPS
+chip_peak_tflops = obs_mfu.chip_peak_tflops
 
 
-def chip_peak_tflops(device_kind: str):
-    for pat, peak in PEAK_TFLOPS:
-        if pat.search(device_kind):
-            return peak
-    return None
+def _sweep_obs_fields(dispatch_fn, step_ms: float,
+                      sampler: HbmSampler) -> dict:
+    """The per-point utilization context the sweeps record alongside
+    steps/s: compiled-program MFU (obs.mfu — on CPU the peak is unknown
+    so mfu is None unless $TPUDIST_PEAK_TFLOPS pins it, but the FLOP and
+    byte counts are always real) and the HBM high-water mark so a perf
+    point's memory footprint rides in the artifact."""
+    sampler.sample()
+    f = obs_mfu.mfu_fields(obs_mfu.dispatch_cost(dispatch_fn),
+                           step_ms / 1000.0)
+    return {"mfu": f["mfu"],
+            "model_flops_per_step": f["model_flops_per_step"],
+            "achieved_gbps_per_chip": f["achieved_gbps_per_chip"],
+            "hbm_peak_bytes": sampler.split()["hbm_peak_bytes"]}
 
 
 def active_params(params, cfg: TrainConfig) -> int:
@@ -189,6 +196,7 @@ def measure(cfg: TrainConfig, iters: int = 60) -> dict:
     state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
     n_active = active_params(state.params, cfg)
     n_params = sum(x.size for x in jax.tree.leaves(state.params))
+    sampler = HbmSampler(period_s=0)
     step = engine.make_train_step(cfg, mesh)
     seq = cfg.model.max_seq_len
     toks = data.make_synthetic_tokens(cfg.batch_size, seq + 1,
@@ -216,7 +224,9 @@ def measure(cfg: TrainConfig, iters: int = 60) -> dict:
     device_kind = jax.devices()[0].device_kind
     peak = chip_peak_tflops(device_kind)
     achieved = train_flops_per_token(n_active, cfg) * tok_s_chip / 1e12
+    sampler.sample()
     return {
+        "hbm_peak_bytes": sampler.split()["hbm_peak_bytes"],
         "device": device_kind,
         "n_devices": n_dev,
         "global_batch": cfg.batch_size,
@@ -246,6 +256,7 @@ def _dispatch_cell(cfg, mesh, k: int, n_steps: int, repeats: int) -> dict:
     bx, by = data.shard_epoch(x, y, batch_size=cfg.batch_size,
                               seed=cfg.seed, epoch=0)
     state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    sampler = HbmSampler(period_s=0)   # manual sampling brackets the cell
 
     if k == 1:
         step = engine.make_train_step(cfg, mesh)
@@ -282,8 +293,10 @@ def _dispatch_cell(cfg, mesh, k: int, n_steps: int, repeats: int) -> dict:
         jax.device_get(loss)                  # fence
         times.append((time.perf_counter() - t0) * 1000 / n_steps)
     ms = statistics.median(times)
+    dispatch_fn = step if k == 1 else superstep
     return {"k": k, "step_ms": round(ms, 4),
-            "steps_per_sec": round(1000 / ms, 1)}
+            "steps_per_sec": round(1000 / ms, 1),
+            **_sweep_obs_fields(dispatch_fn, ms, sampler)}
 
 
 def _staging_runner(cfg, mesh, k: int, n_steps: int, budget_bytes):
@@ -338,7 +351,8 @@ def _staging_runner(cfg, mesh, k: int, n_steps: int, budget_bytes):
     return run_epoch, state, superstep, splan
 
 
-def _staging_row(splan, superstep, budget_bytes, n_steps, ms) -> dict:
+def _staging_row(splan, superstep, budget_bytes, n_steps, ms,
+                 sampler) -> dict:
     return {"mode": "streamed" if splan.streamed else "full_epoch",
             "budget_mb": (None if budget_bytes is None
                           else round(budget_bytes / 2**20, 4)),
@@ -346,7 +360,8 @@ def _staging_row(splan, superstep, budget_bytes, n_steps, ms) -> dict:
             "epoch_mb": round(n_steps * splan.step_bytes / 2**20, 4),
             "superstep_compiles": len(superstep.traces),
             "step_ms": round(ms, 4),
-            "steps_per_sec": round(1000 / ms, 1)}
+            "steps_per_sec": round(1000 / ms, 1),
+            **_sweep_obs_fields(superstep, ms, sampler)}
 
 
 def run_staging_sweep(out_path: str, n_steps: int = 136,
@@ -381,7 +396,10 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
             cfg, mesh, k, n_steps, b)
         state, loss = run_epoch(state)        # trace + compile + warm
         jax.device_get(loss)
-        runners[b] = [run_epoch, state, superstep, splan, []]
+        # per-MODE sampler, created before this mode's timed epochs:
+        # its peak brackets this mode's footprint, not the whole sweep
+        runners[b] = [run_epoch, state, superstep, splan, [],
+                      HbmSampler(period_s=0)]
     # interleave the two modes' timed epochs so host-load drift affects
     # both equally instead of biasing whichever cell ran later
     for _ in range(repeats):
@@ -391,8 +409,9 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
             r[1], loss = r[0](r[1])
             jax.device_get(loss)              # fence
             r[4].append((time.perf_counter() - t0) * 1000 / n_steps)
+            r[5].sample()
     rows = [_staging_row(runners[b][3], runners[b][2], b, n_steps,
-                         statistics.median(runners[b][4]))
+                         statistics.median(runners[b][4]), runners[b][5])
             for (b,) in cells]
     by_mode = {r["mode"]: r for r in rows}
     # ratio as the median of per-round ratios: each round's full and

@@ -26,7 +26,15 @@
 #   RUNTIME_VERSION     TPU software version (default v2-alpha-tpuv5)
 #   IMAGE               docker image to run (default: install this repo's
 #                       package on each worker and run bare python)
-#   TIMEOUT_S           provisioning+run timeout (default 1800)
+#   TIMEOUT_S           provisioning+run timeout (default 1800); the
+#                       training job itself runs under this timeout too,
+#                       and the workload's own stall watchdog (default
+#                       --stall-timeout-s 300) dumps flightrec.worker<i>
+#                       diagnostics well before it fires
+#   OBS_DIR             on-worker directory for heartbeat beacons and
+#                       flight-record dumps (default /tmp/tpudist_obs);
+#                       collected to ./flightrec_artifacts/ on any
+#                       workload failure or timeout
 #   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
 #                       (debugging a slice with a known-red kernel)
 #   SKIP_TESTS_TPU=1    bypass the on-chip pytest lane (tests_tpu/)
@@ -51,6 +59,7 @@ set -euo pipefail
 : "${GCS_VERDICT:?set GCS_VERDICT}"
 RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5}"
 TIMEOUT_S="${TIMEOUT_S:-1800}"
+OBS_DIR="${OBS_DIR:-/tmp/tpudist_obs}"
 POLL_S="${POLL_S:-10}"   # provisioning poll interval (tests shrink it)
 SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
@@ -202,13 +211,43 @@ fi
 # Any worker's nonzero exit fails the ssh command (srun semantics,
 # slurm_train.sbatch:34-44). The verdict is this wrapper's job, from the
 # workload's exit code (same division of labor as the reference sbatch).
+# Bounded: `timeout` converts a hang into rc=124 — by then the workload's
+# own stall watchdog (tpudist.obs, --stall-timeout-s, default 300s) has
+# already dumped per-worker flight records into OBS_DIR, which the
+# failure path below collects. /tmp is shared with containers (-v
+# /tmp:/tmp in RUN_PREFIX), so OBS_DIR under /tmp survives either way.
+# -k 60: SIGTERM first (the workload converts it into an orderly exit
+# that flushes metrics and writes its fail verdict), SIGKILL 60s later
+# if even that wedges
 set +e
-tpu_ssh all "$RUN_PREFIX python3 -m tpudist.train$EXTRA_Q"
+tpu_ssh all "timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
+  --heartbeat-dir $OBS_DIR$EXTRA_Q"
 RC=$?
 set -e
 
+collect_flight_records() {
+  # Pull heartbeat beacons + flight-record dumps off every worker: the
+  # whole point of the flight recorder is that a hung run leaves
+  # evidence of WHICH host and WHICH step died — it must land on the CI
+  # host before the slice is torn down. Per-worker filenames
+  # (flightrec.worker<i>) cannot collide. Best-effort: a dead worker
+  # must not block the verdict.
+  echo "collecting flight-recorder artifacts from $OBS_DIR ..."
+  mkdir -p flightrec_artifacts
+  gcloud compute tpus tpu-vm scp --recurse "$TPU_NAME:$OBS_DIR/*" \
+    flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
+    --worker=all 2>/dev/null || true
+  ls -l flightrec_artifacts/ 2>/dev/null || true
+}
+
 if [ $RC -ne 0 ]; then
-  echo "❌ distributed TPU job failed (rc=$RC)"
+  if [ $RC -eq 124 ]; then
+    echo "❌ distributed TPU job TIMED OUT after ${TIMEOUT_S}s (hang — " \
+         "see flight records for the wedged host/step)"
+  else
+    echo "❌ distributed TPU job failed (rc=$RC)"
+  fi
+  collect_flight_records
   fail_verdict
   # clamp to 1: the workload's raw code must not collide with this
   # script's documented exit contract (2 = sweep gate fail, 3 = sweep

@@ -135,6 +135,43 @@ def test_workload_failure_writes_fail_and_exits_1(stub_env):
     assert (stub / "deleted").exists()
 
 
+def test_train_runs_bounded_with_heartbeat_dir(stub_env):
+    """The workload runs under `timeout TIMEOUT_S` (a hang becomes a
+    bounded rc=124, not an eternal ssh) and with --heartbeat-dir pointed
+    at OBS_DIR so the flight recorder's artifacts land where the failure
+    path collects them."""
+    env, stub = stub_env
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    tr_line = [ln for ln in (stub / "calls.log").read_text().splitlines()
+               if "tpudist.train" in ln][0]
+    # TIMEOUT_S=30 fixture; -k: SIGKILL backstop behind the orderly TERM
+    assert "timeout -k 60 30" in tr_line
+    assert "--heartbeat-dir /tmp/tpudist_obs" in tr_line
+
+
+def test_flight_records_collected_on_workload_failure(stub_env):
+    """A red training run pulls heartbeat/flightrec artifacts off the
+    workers BEFORE teardown — the whole point of the flight recorder is
+    that the evidence survives the slice."""
+    env, stub = stub_env
+    env["STUB_TRAIN_RC"] = "1"
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    scp_lines = [ln for ln in (stub / "calls.log").read_text().splitlines()
+                 if "scp" in ln and "tpudist_obs" in ln]
+    assert scp_lines and "--worker=all" in scp_lines[0]
+
+
+def test_no_flight_record_collection_on_success(stub_env):
+    env, stub = stub_env
+    r = launch(env)
+    assert r.returncode == 0
+    assert not [ln for ln in (stub / "calls.log").read_text().splitlines()
+                if "scp" in ln and "tpudist_obs" in ln]
+
+
 def test_probe_mismatch_fails_before_training(stub_env):
     env, stub = stub_env
     env["STUB_PROBE_RC"] = "1"

@@ -1,0 +1,487 @@
+"""The pod flight recorder (tpudist.obs): heartbeat beacon + stall
+watchdog, flight-record dumps, HBM watermarks, per-host straggler
+aggregation, and compiled-program MFU accounting — plus their wiring
+through the train CLI's ``kind=timing`` / ``kind=hosts`` records.
+
+The stall tests simulate the dominant pod failure mode (a wedged step —
+single-host stand-in for a worker stuck in a collective) and assert the
+artifact carries a *diagnosis*: which phase/step died, whose stack was
+wedged, what the devices held.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpudist import engine
+from tpudist import train as train_mod
+from tpudist import verdict as verdict_lib
+from tpudist.config import TrainConfig, resolve_obs
+from tpudist.metrics import MetricsLogger, StepTimer
+from tpudist.obs import (FlightRecorder, HbmSampler, HostStepStats,
+                         PodObserver, mfu)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------- heartbeat + watchdog
+
+
+def _wait_for(cond, timeout_s=10.0):
+    deadline = time.monotonic() + timeout_s
+    while not cond() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    return cond()
+
+
+class TestFlightRecorder:
+    def test_healthy_run_beats_and_never_dumps(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), stall_timeout_s=0.4)
+        try:
+            for step in range(6):
+                rec.note_progress(phase="train", epoch=0, step=step)
+                time.sleep(0.05)
+            assert _wait_for(lambda: rec.beacons >= 1)
+        finally:
+            rec.close()   # writes the final beacon with latest progress
+        beacon = json.load(open(rec.beacon_path))
+        assert beacon["phase"] == "train" and beacon["step"] == 5
+        assert beacon["pid"] == os.getpid()
+        assert rec.dumps == 0
+        assert not os.path.exists(rec.flightrec_path)
+
+    def test_stall_dumps_within_window(self, tmp_path):
+        metrics = MetricsLogger(path=str(tmp_path / "metrics.jsonl"))
+        rec = FlightRecorder(str(tmp_path), stall_timeout_s=0.3,
+                             metrics=metrics)
+        try:
+            rec.note_progress(phase="train", epoch=1, step=7)
+            metrics.log(kind="step", step=7, loss=0.5)
+
+            def wedged_collective():     # named frame the dump must show
+                assert _wait_for(lambda: rec.dumps >= 1)
+            t0 = time.monotonic()
+            wedged_collective()
+            # "within --stall-timeout-s": fired promptly, not at some
+            # multiple of the window
+            assert time.monotonic() - t0 < 10 * 0.3
+            # dump-time flush asserted BEFORE close() (whose own flush
+            # would mask the crash-safety behavior under test)
+            recs = [json.loads(ln)
+                    for ln in open(tmp_path / "metrics.jsonl")]
+            assert recs and recs[-1]["step"] == 7
+        finally:
+            rec.close()
+            metrics.close()
+        art = json.load(open(rec.flightrec_path))
+        assert art["reason"] == "stall" and art["stall_s"] >= 0.3
+        assert art["progress"]["step"] == 7
+        assert art["progress"]["epoch"] == 1
+        assert art["progress"]["phase"] == "train"
+        assert "wedged_collective" in art["thread_stacks"]
+        assert isinstance(art["memory_stats"], list)
+        assert art["last_metrics"][-1]["step"] == 7
+
+    def test_dump_fires_once_per_stall_and_rearms(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), stall_timeout_s=0.2)
+        try:
+            rec.note_progress(step=1)
+            assert _wait_for(lambda: rec.dumps >= 1)
+            time.sleep(0.6)              # still stalled: no repeat dumps
+            assert rec.dumps == 1
+            rec.note_progress(step=2)    # progress resumes…
+            time.sleep(0.1)
+            assert _wait_for(lambda: rec.dumps >= 2)   # …then stalls again
+        finally:
+            rec.close()
+
+    def test_watchdog_disabled_with_zero_timeout(self, tmp_path):
+        rec = FlightRecorder(str(tmp_path), stall_timeout_s=0)
+        try:
+            rec.note_progress(step=0)
+            assert _wait_for(lambda: rec.beacons >= 1, timeout_s=15)
+            assert rec.dumps == 0        # beacon beats, watchdog off
+        finally:
+            rec.close()
+        assert not os.path.exists(rec.flightrec_path)
+
+    def test_negative_stall_timeout_rejected(self, tmp_path):
+        with pytest.raises(ValueError):
+            FlightRecorder(str(tmp_path), stall_timeout_s=-1)
+
+
+def test_selfcheck_flight_recorder_drill(tmp_path, monkeypatch, capsys):
+    """The CI forced-stall drill (selfcheck.check_flight_recorder) passes
+    on the CPU backend and leaves its artifacts in $TPUDIST_OBS_DIR."""
+    from tpudist import selfcheck
+    monkeypatch.setenv("TPUDIST_OBS_DIR", str(tmp_path))
+    selfcheck.check_flight_recorder()
+    assert (tmp_path / "flightrec.worker0").exists()
+    assert (tmp_path / "heartbeat.worker0").exists()
+    assert selfcheck.check_flight_recorder in selfcheck.CHECKS
+
+
+# ------------------------------------------------------- HBM watermarks
+
+
+class TestHbmSampler:
+    def test_peak_populated_on_cpu_via_rss_fallback(self):
+        s = HbmSampler(period_s=0)       # manual mode: no thread
+        split = s.split()
+        assert split["hbm_peak_bytes"] and split["hbm_peak_bytes"] > 0
+        assert split["hbm_source"] in ("memory_stats", "rss")
+        s.close()
+
+    def test_watermark_is_monotone(self):
+        s = HbmSampler(period_s=0)
+        p0 = s.peak_in_use
+        ballast = bytearray(32 * 2**20)  # grow RSS
+        s.sample()
+        assert s.peak_in_use >= p0
+        del ballast
+        s.sample()
+        assert s.peak_in_use >= p0       # high-water mark never recedes
+        s.close()
+
+    def test_background_thread_samples(self):
+        s = HbmSampler(period_s=0.05)
+        assert _wait_for(lambda: s.samples >= 3)
+        s.close()
+
+    def test_transient_stats_failure_does_not_contaminate_with_rss(
+            self, monkeypatch):
+        """On a device-stats backend, ONE failed poll must not fold host
+        RSS (tens of GB on a TPU VM) into the never-receding device
+        watermark."""
+        import jax
+        s = HbmSampler(period_s=0)
+        s.source, s.peak_in_use, s.last_in_use = "memory_stats", 100, 90
+        monkeypatch.setattr(jax, "local_devices",
+                            lambda: (_ for _ in ()).throw(RuntimeError()))
+        s.sample()
+        assert s.source == "memory_stats"
+        assert s.peak_in_use == 100 and s.last_in_use == 90
+        s.close()
+
+    def test_negative_period_rejected(self):
+        with pytest.raises(ValueError):
+            HbmSampler(period_s=-0.1)
+
+
+# --------------------------------------------------------- MFU accounting
+
+
+def _tiny_cfg(n_steps=8, batch=64):
+    from tpudist.config import DataConfig, ParallelConfig
+    return TrainConfig(batch_size=batch, lr=1e-3, seed=0,
+                       data=DataConfig(n_samples=n_steps * batch),
+                       parallel=ParallelConfig(data=-1))
+
+
+class TestMfu:
+    def test_fields_from_fake_cost_with_pinned_peak(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_PEAK_TFLOPS", "1")   # 1 TFLOP/s peak
+        f = mfu.mfu_fields({"flops": 2e9, "bytes accessed": 1e9},
+                           step_s=0.01)
+        assert f["model_flops_per_step"] == 2e9
+        assert f["achieved_tflops_per_chip"] == pytest.approx(0.2)
+        assert f["mfu"] == pytest.approx(0.2)
+        assert f["achieved_gbps_per_chip"] == pytest.approx(100.0)
+
+    def test_degrades_to_none_without_cost_or_steps(self):
+        f = mfu.mfu_fields(None, step_s=0.01)
+        assert f["mfu"] is None and f["model_flops_per_step"] is None
+        f = mfu.mfu_fields({"flops": 1e9}, step_s=0.0)
+        assert f["mfu"] is None
+
+    def test_peak_table_and_env_override(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_PEAK_TFLOPS", raising=False)
+        assert mfu.chip_peak_tflops("TPU v5 lite") == 197.0
+        assert mfu.chip_peak_tflops("TPU v5p") == 459.0
+        assert mfu.chip_peak_tflops("cpu") is None
+        monkeypatch.setenv("TPUDIST_PEAK_TFLOPS", "123.5")
+        assert mfu.chip_peak_tflops("cpu") == 123.5
+
+    def test_superstep_cost_is_per_step_scan_body_counted_once(self):
+        """THE load-bearing pin for MFU math: XLA's cost analysis visits
+        a lax.scan body once (trip count not multiplied), so the k-step
+        superstep program must report the SAME flops as the k=1 per-step
+        program — if a future XLA changes this, mfu would silently skew
+        by k× and this test catches it."""
+        import jax
+        from tpudist import data
+        from tpudist.parallel import build_mesh
+        from tpudist.parallel import sharding as shd
+        import jax.numpy as jnp
+        cfg = _tiny_cfg()
+        mesh = build_mesh(cfg.parallel)
+        x, y = data.make_synthetic_data(8 * 64, 20, 0)
+        bx, by = data.shard_epoch(x, y, batch_size=64, seed=0, epoch=0)
+
+        state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+        step = engine.make_train_step(cfg, mesh)
+        assert step.cost_analysis() is None      # pre-first-call contract
+        state, _ = step(state, (bx[0], by[0]))
+        per_step = step.cost_analysis()["flops"]
+
+        state4 = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+        ss = engine.make_superstep(cfg, mesh, 4)
+        slab = shd.put_epoch(mesh, (bx[:4], by[:4]))
+        state4, total, _ = ss(state4, jnp.zeros((), jnp.float32), slab,
+                              0, 4)
+        per_superstep = ss.cost_analysis()["flops"]
+        assert per_superstep == pytest.approx(per_step, rel=0.02)
+        # and the cost probe must not retrace the superstep (the
+        # compile-count pins elsewhere depend on traces == 1)
+        assert len(ss.traces) == 1
+
+
+# -------------------------------------------------- straggler aggregation
+
+
+class TestHostStats:
+    def test_single_host_is_ungateable(self):
+        m = MetricsLogger(path=None)
+        hs = HostStepStats(process_index=0, process_count=1)
+        t = StepTimer()
+        t.steps, t.elapsed = 100, 1.0
+        assert hs.epoch_end(0, t, m) == verdict_lib.UNGATEABLE
+        rec = m.history[-1]
+        assert rec["kind"] == "hosts"
+        (h,) = rec["hosts"]
+        assert h["process"] == 0 and h["steps"] == 100
+        assert h["step_s_mean"] == pytest.approx(0.01)  # f32 allgather
+        m.close()
+
+    def test_epoch_deltas_not_cumulative(self):
+        m = MetricsLogger(path=None)
+        hs = HostStepStats()
+        t = StepTimer()
+        t.steps, t.elapsed = 100, 1.0
+        hs.epoch_end(0, t, m)
+        t.steps, t.elapsed = 150, 2.0    # epoch 1: 50 steps in 1s
+        hs.epoch_end(1, t, m)
+        assert m.history[-1]["hosts"][0]["step_s_mean"] == \
+            pytest.approx(0.02)
+        m.close()
+
+    def test_multi_host_fail_flagged(self, monkeypatch):
+        import numpy as np
+        m = MetricsLogger(path=None)
+        hs = HostStepStats(process_index=0, process_count=4)
+        # 4 hosts, one 2x slower than the median
+        rows = np.asarray([[0, 100, 0.010], [1, 100, 0.011],
+                           [2, 100, 0.020], [3, 100, 0.010]], np.float32)
+        monkeypatch.setattr(hs, "_gather", lambda steps, mean: rows)
+        t = StepTimer()
+        t.steps, t.elapsed = 100, 1.0
+        assert hs.epoch_end(0, t, m) == verdict_lib.FAIL
+        rec = m.history[-1]
+        assert rec["straggler_status"] == verdict_lib.FAIL
+        assert rec["worst_step_s"] == pytest.approx(0.020, rel=1e-5)
+        assert rec["straggler_ratio"] > 1.5
+        m.close()
+
+
+# ----------------------------------------------- StepTimer full precision
+
+
+def test_step_timer_split_keeps_full_precision():
+    """MFU math divides by run_s; 3-decimal rounding quantized fast CPU
+    runs (run_s 0.0004 -> 0.0) — the record keeps full floats, rounding
+    is display-only (satellite)."""
+    t = StepTimer()
+    t.warmup_s = 0.123456789
+    t.elapsed = 0.000444444
+    t.steps = 7
+    s = t.split()
+    assert s["compile_warmup_s"] == 0.123456789
+    assert s["run_s"] == 0.000444444
+    assert s["steps"] == 7
+
+
+# ------------------------------------------ metrics crash-safety (atexit)
+
+
+def test_metrics_flushed_on_unhandled_exception(tmp_path):
+    """A run that dies between flushes must not lose its buffered
+    records: the atexit hook writes the tail on interpreter exit."""
+    path = tmp_path / "metrics.jsonl"
+    script = (
+        "from tpudist.metrics import MetricsLogger\n"
+        f"m = MetricsLogger(path={str(path)!r})\n"
+        "m.log(kind='step', step=1, loss=0.5)\n"
+        "m.log(kind='step', step=2, loss=0.4)\n"
+        "raise RuntimeError('died between flushes')\n")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run([sys.executable, "-c", script], cwd=REPO, env=env,
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode != 0 and "died between flushes" in r.stderr
+    recs = [json.loads(ln) for ln in open(path)]
+    assert [rec["step"] for rec in recs] == [1, 2]
+
+
+def test_metrics_close_unregisters_atexit(tmp_path):
+    """A closed logger must not re-flush at exit (its handle is gone and
+    long processes would leak one registration per run)."""
+    import atexit
+    m = MetricsLogger(path=str(tmp_path / "m.jsonl"))
+    m.log(kind="x")
+    m.close()
+    # closing again (train.run closes twice on the happy path) is fine
+    m.close()
+    assert not m._buf
+    # unregistered: calling the would-be hook is now a no-op
+    atexit.unregister(m.flush)
+
+
+# -------------------------------------------------- config resolution
+
+
+class TestResolveObs:
+    def test_defaults(self, monkeypatch):
+        for v in ("TPUDIST_STALL_TIMEOUT_S", "TPUDIST_HEARTBEAT_DIR",
+                  "TPUDIST_HBM_SAMPLE_S"):
+            monkeypatch.delenv(v, raising=False)
+        stall, out_dir, hbm = resolve_obs(TrainConfig(save_dir="/sd"))
+        assert stall == 300.0 and out_dir == "/sd" and hbm == 2.0
+
+    def test_env_overrides(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STALL_TIMEOUT_S", "12.5")
+        monkeypatch.setenv("TPUDIST_HEARTBEAT_DIR", "/beats")
+        monkeypatch.setenv("TPUDIST_HBM_SAMPLE_S", "0.5")
+        stall, out_dir, hbm = resolve_obs(TrainConfig(save_dir="/sd"))
+        assert (stall, out_dir, hbm) == (12.5, "/beats", 0.5)
+
+    def test_flags_beat_env(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STALL_TIMEOUT_S", "12.5")
+        monkeypatch.setenv("TPUDIST_HEARTBEAT_DIR", "/beats")
+        cfg = TrainConfig(save_dir="/sd", stall_timeout_s=7.0,
+                          heartbeat_dir="/flag", hbm_sample_s=0.0)
+        assert resolve_obs(cfg) == (7.0, "/flag", 0.0)
+
+    def test_negative_values_rejected(self):
+        with pytest.raises(ValueError):
+            resolve_obs(TrainConfig(stall_timeout_s=-1))
+        with pytest.raises(ValueError):
+            resolve_obs(TrainConfig(hbm_sample_s=-1))
+
+    def test_garbage_env_reads_as_unset(self, monkeypatch):
+        """A malformed fleet-wide env export must not kill every run at
+        startup — an advisory knob degrades to its default (explicit
+        flags still fail fast above)."""
+        monkeypatch.setenv("TPUDIST_STALL_TIMEOUT_S", "5m")
+        monkeypatch.setenv("TPUDIST_HBM_SAMPLE_S", "fast")
+        monkeypatch.delenv("TPUDIST_HEARTBEAT_DIR", raising=False)
+        stall, out_dir, hbm = resolve_obs(TrainConfig(save_dir="/sd"))
+        assert (stall, hbm) == (300.0, 2.0)
+
+    def test_cli_flags_parse(self):
+        from tpudist.config import parse_args
+        cfg = parse_args(["--stall-timeout-s", "45", "--heartbeat-dir",
+                          "/hb", "--hbm-sample-s", "0.25"])
+        assert cfg.stall_timeout_s == 45.0
+        assert cfg.heartbeat_dir == "/hb"
+        assert cfg.hbm_sample_s == 0.25
+
+
+# ------------------------------------------------ end-to-end train wiring
+
+
+def _timing_record(save_dir):
+    recs = [json.loads(ln)
+            for ln in open(os.path.join(save_dir, "metrics.jsonl"))]
+    return recs, [r for r in recs if r["kind"] == "timing"][0]
+
+
+def test_train_cli_timing_record_carries_obs_fields(tmp_path, capsys,
+                                                    monkeypatch):
+    """Acceptance pin: kind=timing carries mfu, hbm_peak_bytes and
+    straggler_status; kind=hosts records exist per epoch; the heartbeat
+    beacon lands next to metrics.jsonl; a HEALTHY run leaves no flight
+    record."""
+    monkeypatch.setenv("TPUDIST_PEAK_TFLOPS", "0.1")   # make mfu a number
+    save = tmp_path / "ck"
+    rc = train_mod.main(["--epochs", "2", "--train-batch-size", "64",
+                         "--log-every", "4", "--save-dir", str(save)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpudist: mfu" in out and "tpudist: hbm peak" in out
+    recs, t = _timing_record(str(save))
+    assert t["mfu"] and 0 < t["mfu"] < 1
+    assert t["model_flops_per_step"] > 0
+    assert t["hbm_peak_bytes"] > 0 and t["hbm_source"] in ("memory_stats",
+                                                           "rss")
+    assert t["straggler_status"] == verdict_lib.UNGATEABLE  # 1 host
+    assert t["run_s"] > 0                 # full precision, not rounded out
+    hosts = [r for r in recs if r["kind"] == "hosts"]
+    assert len(hosts) == 2                # one per epoch
+    assert all(h["hosts"][0]["steps"] > 0 for h in hosts[1:])
+    beacon = json.load(open(save / "heartbeat.worker0"))
+    assert beacon["phase"] == "shutdown"
+    assert not (save / "flightrec.worker0").exists()
+
+
+def test_train_cli_per_step_dispatch_also_reports_mfu(tmp_path, capsys,
+                                                      monkeypatch):
+    """k=1 goes through make_train_step's cost hook, not the superstep's."""
+    monkeypatch.setenv("TPUDIST_PEAK_TFLOPS", "0.1")
+    save = tmp_path / "ck"
+    rc = train_mod.main(["--epochs", "1", "--train-batch-size", "64",
+                         "--steps-per-dispatch", "1",
+                         "--save-dir", str(save)])
+    capsys.readouterr()
+    assert rc == 0
+    _, t = _timing_record(str(save))
+    assert t["mfu"] and t["model_flops_per_step"] > 0
+
+
+def test_sigterm_exits_orderly_with_fail_verdict_and_metrics(tmp_path):
+    """The launcher's `timeout` kill sends SIGTERM, which by default
+    skips atexit AND finally blocks. train.main converts it into an
+    orderly exit: the fail verdict is written and the buffered metrics
+    tail is flushed — the primary kill path must not be the one that
+    loses the evidence."""
+    import signal
+    save = tmp_path / "ck"
+    vpath = tmp_path / "job_status.txt"
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               TPUDIST_VERDICT_PATH=str(vpath))
+    p = subprocess.Popen(
+        [sys.executable, "-m", "tpudist.train", "--epochs", "500",
+         "--train-batch-size", "64", "--log-every", "4",
+         "--save-dir", str(save)],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True)
+    try:
+        # metrics.jsonl appears at the first epoch-end flush — the run
+        # is then demonstrably mid-training, past compile
+        assert _wait_for(lambda: (save / "metrics.jsonl").exists(),
+                         timeout_s=90), "run never reached epoch 1"
+        time.sleep(0.3)                    # let some records buffer
+        p.send_signal(signal.SIGTERM)
+        out, _ = p.communicate(timeout=60)
+    finally:
+        p.kill()
+    assert p.returncode != 0
+    assert "terminated by signal" in out
+    assert vpath.with_name("job_status.txt.worker0").read_text() == "fail"
+    assert vpath.read_text() == "fail"
+    recs = [json.loads(ln) for ln in open(save / "metrics.jsonl")]
+    assert recs, "buffered metrics lost on SIGTERM"
+
+
+def test_pod_observer_hbm_off(tmp_path):
+    obs = PodObserver(out_dir=str(tmp_path), stall_timeout_s=0,
+                      hbm_sample_s=0)
+    try:
+        fields = obs.hbm_fields()
+        assert fields["hbm_peak_bytes"] is None
+        assert fields["hbm_source"] == "off"
+    finally:
+        obs.close()
+    obs.close()   # idempotent

@@ -35,7 +35,8 @@ def test_check_registry_covers_both_kernels_and_both_models():
     assert len(names) == len(set(names))
     joined = " ".join(names)
     # the load-bearing coverage: both pallas kernels (incl. the multi-block
-    # long-context schedule and GQA), and a train smoke per model family
+    # long-context schedule and GQA), a train smoke per model family, and
+    # the forced-stall flight-recorder drill (CI's observability gate)
     for needle in ("fused_xent", "flash_attention", "long_context", "gqa",
-                   "train_step", "moe"):
+                   "train_step", "moe", "flight_recorder"):
         assert needle in joined, f"selfcheck lane lost its {needle} check"

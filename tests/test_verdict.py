@@ -1,0 +1,127 @@
+"""The verdict layer's untested edge branches (ISSUE 3 satellite):
+``aggregate_status``'s bounded-timeout path (a dead peer converts a hang
+into a local fail verdict) and the three-valued ``staging_status`` /
+``straggler_status`` thresholds with their call-time env overrides."""
+
+import time
+
+import jax
+import pytest
+
+from tpudist import verdict
+
+
+# ------------------------------------------------------ aggregate_status
+
+
+class TestAggregateStatus:
+    def test_single_process_short_circuits(self):
+        assert verdict.aggregate_status(True) == (True, False)
+        assert verdict.aggregate_status(False) == (False, False)
+
+    def _fake_world(self, monkeypatch, gather):
+        """2-process world whose allgather is scripted: aggregate_status
+        imports multihost_utils inside, so patching the module attribute
+        reaches it."""
+        from jax.experimental import multihost_utils
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        monkeypatch.setattr(multihost_utils, "process_allgather", gather)
+
+    def test_timeout_path_returns_local_fail(self, monkeypatch):
+        """A peer that died before the barrier makes the allgather HANG;
+        the bounded wait must convert that into (False, timed_out=True)
+        within ~timeout_s instead of blocking until the launcher kills
+        the process."""
+        self._fake_world(monkeypatch, lambda x: time.sleep(30))
+        t0 = time.monotonic()
+        ok, timed_out = verdict.aggregate_status(True, timeout_s=0.2)
+        assert (ok, timed_out) == (False, True)
+        assert time.monotonic() - t0 < 5.0
+
+    def test_all_ok_aggregates_true(self, monkeypatch):
+        import jax.numpy as jnp
+        self._fake_world(monkeypatch, lambda x: jnp.asarray([1, 1]))
+        assert verdict.aggregate_status(True, timeout_s=5) == (True, False)
+
+    def test_any_peer_failure_fails_the_job(self, monkeypatch):
+        """srun semantics: one bad worker fails the whole job."""
+        import jax.numpy as jnp
+        self._fake_world(monkeypatch, lambda x: jnp.asarray([1, 0]))
+        ok, timed_out = verdict.aggregate_status(True, timeout_s=5)
+        assert (ok, timed_out) == (False, False)
+
+    def test_timeout_env_default(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_AGGREGATE_TIMEOUT_S", "0.1")
+        self._fake_world(monkeypatch, lambda x: time.sleep(30))
+        t0 = time.monotonic()
+        ok, timed_out = verdict.aggregate_status(True)   # env supplies 0.1
+        assert (ok, timed_out) == (False, True)
+        assert time.monotonic() - t0 < 5.0
+
+
+# -------------------------------------------------------- staging_status
+
+
+class TestStagingStatus:
+    def test_three_values_at_default_threshold(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_STAGING_OVERLAP_MIN", raising=False)
+        assert verdict.staging_status(False, 0.9) == verdict.UNGATEABLE
+        assert verdict.staging_status(True, None) == verdict.UNGATEABLE
+        assert verdict.staging_status(True, 0.5) == verdict.SUCCESS  # ==
+        assert verdict.staging_status(True, 0.49) == verdict.FAIL
+
+    def test_env_override_read_at_call_time(self, monkeypatch):
+        """TPUDIST_STAGING_OVERLAP_MIN must take effect WITHOUT a module
+        reload (the old import-time read silently ignored per-run
+        overrides)."""
+        monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "0.9")
+        assert verdict.staging_status(True, 0.8) == verdict.FAIL
+        monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "0.1")
+        assert verdict.staging_status(True, 0.8) == verdict.SUCCESS
+
+    def test_explicit_threshold_beats_env(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "0.9")
+        assert verdict.staging_status(True, 0.8,
+                                      min_overlap=0.5) == verdict.SUCCESS
+
+    def test_garbage_env_falls_back_to_default(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "not-a-float")
+        assert verdict.staging_status(True, 0.6) == verdict.SUCCESS
+        assert verdict.staging_status(True, 0.4) == verdict.FAIL
+
+
+# ------------------------------------------------------ straggler_status
+
+
+class TestStragglerStatus:
+    def test_fewer_than_two_hosts_ungateable(self):
+        assert verdict.straggler_status([]) == verdict.UNGATEABLE
+        assert verdict.straggler_status([0.01]) == verdict.UNGATEABLE
+        # zero/None entries (warmup-only hosts) don't count as reporters
+        assert verdict.straggler_status([0.01, 0.0, None]) == \
+            verdict.UNGATEABLE
+
+    def test_within_factor_success(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_STRAGGLER_FACTOR", raising=False)
+        assert verdict.straggler_status([0.010, 0.011, 0.012]) == \
+            verdict.SUCCESS
+
+    def test_straggler_fails(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_STRAGGLER_FACTOR", raising=False)
+        # median 0.010; 0.020 is 2.0x > 1.25x default
+        assert verdict.straggler_status([0.010, 0.010, 0.020]) == \
+            verdict.FAIL
+
+    def test_env_factor_override(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STRAGGLER_FACTOR", "3.0")
+        assert verdict.straggler_status([0.010, 0.010, 0.020]) == \
+            verdict.SUCCESS
+        monkeypatch.setenv("TPUDIST_STRAGGLER_FACTOR", "1.05")
+        assert verdict.straggler_status([0.010, 0.010, 0.011]) == \
+            verdict.FAIL
+
+    def test_boundary_is_inclusive(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_STRAGGLER_FACTOR", raising=False)
+        # exactly factor*median is NOT a straggler (> , not >=)
+        assert verdict.straggler_status([0.010, 0.010, 0.0125]) == \
+            verdict.SUCCESS

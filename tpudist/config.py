@@ -111,6 +111,14 @@ class TrainConfig:
     # else auto from device memory stats minus the train-state estimate
     # (resolve_staging_budget_bytes); epochs over budget stream in
     # double-buffered slabs instead of staging whole
+    stall_timeout_s: Optional[float] = None  # flight-recorder watchdog: no
+    # step progress for this long -> dump stacks/memory/last-metrics to
+    # flightrec.worker<i> (obs.heartbeat). None = $TPUDIST_STALL_TIMEOUT_S,
+    # else 300; 0 disables the watchdog (the heartbeat beacon still beats)
+    heartbeat_dir: Optional[str] = None  # where heartbeat.worker<i> /
+    # flightrec.worker<i> land. None = $TPUDIST_HEARTBEAT_DIR, else save_dir
+    hbm_sample_s: Optional[float] = None  # HBM watermark sampler period
+    # (obs.hbm). None = $TPUDIST_HBM_SAMPLE_S, else 2.0; 0 disables
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -218,6 +226,57 @@ def resolve_staging_budget_bytes(cfg: TrainConfig, *, state_bytes: int = 0,
     free = max(hbm_bytes - STAGING_STATE_HEADROOM * state_bytes,
                hbm_bytes * STAGING_FLOOR_FRACTION)
     return int(free * STAGING_FREE_FRACTION)
+
+
+# Flight-recorder defaults: the stall window must comfortably exceed any
+# legitimate quiet period (a cold compile of the flagship superstep is
+# ~1-2 min on TPU) while still firing well inside the launcher's outer
+# TIMEOUT_S (default 1800) — the dump has to land BEFORE the kill.
+OBS_STALL_TIMEOUT_S = 300.0
+OBS_HBM_SAMPLE_S = 2.0
+
+
+def _env_float(name: str) -> Optional[float]:
+    """Optional float env var; a malformed value reads as unset (an
+    advisory observability knob must never kill a run at startup —
+    same swallow-and-default semantics as verdict._env_float). An
+    explicit FLAG, by contrast, still raises below: typos on the
+    command line should fail fast."""
+    raw = os.environ.get(name)
+    if not raw:
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        return None
+
+
+def resolve_obs(cfg: TrainConfig) -> tuple[float, str, float]:
+    """Resolve the flight-recorder knobs to concrete values:
+    ``(stall_timeout_s, out_dir, hbm_sample_s)``.
+
+    Precedence per knob: explicit flag > env var > default. The beacon /
+    flight-record directory defaults to ``save_dir`` so the artifacts
+    land next to ``metrics.jsonl`` — one directory to collect when a run
+    dies.
+    """
+    stall = cfg.stall_timeout_s
+    if stall is None:
+        stall = _env_float("TPUDIST_STALL_TIMEOUT_S")
+    if stall is None:
+        stall = OBS_STALL_TIMEOUT_S
+    if stall < 0:
+        raise ValueError(f"--stall-timeout-s must be >= 0, got {stall}")
+    out_dir = (cfg.heartbeat_dir or os.environ.get("TPUDIST_HEARTBEAT_DIR")
+               or cfg.save_dir)
+    hbm_s = cfg.hbm_sample_s
+    if hbm_s is None:
+        hbm_s = _env_float("TPUDIST_HBM_SAMPLE_S")
+    if hbm_s is None:
+        hbm_s = OBS_HBM_SAMPLE_S
+    if hbm_s < 0:
+        raise ValueError(f"--hbm-sample-s must be >= 0, got {hbm_s}")
+    return stall, out_dir, hbm_s
 
 
 def flagship_model_config(max_seq_len: int = 512) -> ModelConfig:
@@ -329,6 +388,22 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="persistent XLA compilation cache directory "
                         "(default: $TPUDIST_COMPILATION_CACHE_DIR); repeat "
                         "runs reuse compiled programs instead of retracing")
+    p.add_argument("--stall-timeout-s", type=float, default=None,
+                   help="flight-recorder watchdog: no step progress for "
+                        "this long dumps thread stacks + memory stats + "
+                        "last-N metrics to flightrec.worker<i> before the "
+                        "launcher kills the job (default: "
+                        "$TPUDIST_STALL_TIMEOUT_S, else 300; 0 disables "
+                        "the watchdog, beacon stays on)")
+    p.add_argument("--heartbeat-dir", type=str, default=None,
+                   help="directory for heartbeat.worker<i> beacons and "
+                        "flightrec.worker<i> dumps (default: "
+                        "$TPUDIST_HEARTBEAT_DIR, else --save-dir)")
+    p.add_argument("--hbm-sample-s", type=float, default=None,
+                   help="HBM watermark sampler period in seconds; the "
+                        "high-water mark lands in the kind=timing record "
+                        "(default: $TPUDIST_HBM_SAMPLE_S, else 2.0; "
+                        "0 disables)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write jax.profiler traces (tensorboard format) "
                         "here; the reference had no profiling at all "
@@ -359,6 +434,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         steps_per_dispatch=args.steps_per_dispatch,
         compilation_cache_dir=args.compilation_cache_dir,
         staging_budget_mb=args.staging_budget_mb,
+        stall_timeout_s=args.stall_timeout_s,
+        heartbeat_dir=args.heartbeat_dir,
+        hbm_sample_s=args.hbm_sample_s,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

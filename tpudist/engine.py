@@ -469,11 +469,49 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
     return body, dp, st_sh
 
 
+def _arg_specs(args):
+    """Shape/dtype/sharding skeletons of a call's arguments — what
+    ``jit.lower`` needs, WITHOUT keeping any buffer alive (holding the
+    last staged slab would break the streaming pipeline's ≤2-resident
+    guarantee; donated states are deleted but their avals survive).
+    Only NamedShardings are kept: host-created scalars (total, lo, hi)
+    carry a SingleDeviceSharding that would contradict the mesh-wide
+    state at lowering — the real call passes them uncommitted and the
+    specs must reproduce that."""
+    def spec(a):
+        sh = getattr(a, "sharding", None)
+        if not isinstance(sh, NamedSharding):
+            sh = None
+        return jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=sh)
+    return jax.tree.map(spec, args)
+
+
+def _cost_analysis_hook(jitted, cell) -> Callable:
+    """Build the ``.cost_analysis()`` accessor attached to the step /
+    superstep callables: XLA's cost properties (flops, bytes accessed)
+    of the EXACT program the run dispatched (tpudist.obs.mfu reads this
+    for the run-end roofline record). ``cell[0]`` holds the first call's
+    arg specs. Lowering + compiling here is off the step path, runs at
+    most once per run, and hits the persistent compilation cache when
+    one is configured; any failure degrades to None — observability
+    must never fail a run."""
+    def cost_analysis():
+        if cell[0] is None:
+            return None
+        try:
+            return compat.cost_analysis(jitted.lower(*cell[0]).compile())
+        except Exception:
+            return None
+    return cost_analysis
+
+
 def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     """Build the compiled train step: (TrainState, batch) -> (TrainState, loss).
 
     Chooses the explicit-psum shard_map path for pure-DP meshes, else the
-    jit+shardings path. Loss returned is the global mean.
+    jit+shardings path. Loss returned is the global mean. The returned
+    callable exposes ``.cost_analysis()`` (compiled-program flops/bytes,
+    None before the first call) for the observability layer.
     """
     body, dp, st_sh = _build_step_body(cfg, mesh)
 
@@ -497,8 +535,14 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
                          out_shardings=(st_sh, NamedSharding(mesh, P())),
                          donate_argnums=(0,))
 
+    _specs: list = [None]
+
     def step(state, batch):
-        return jitted(state, shd.put_batch(mesh, batch))
+        staged = shd.put_batch(mesh, batch)
+        if _specs[0] is None:
+            _specs[0] = _arg_specs((state, staged))
+        return jitted(state, staged)
+    step.cost_analysis = _cost_analysis_hook(jitted, _specs)
     return step
 
 
@@ -620,9 +664,15 @@ def make_superstep(cfg: TrainConfig, mesh: Mesh, k: int) -> Callable:
                          out_shardings=(st_sh, rep, rep),
                          donate_argnums=(0, 1))
 
+    _specs: list = [None]
+
     def superstep(state, total, slab, lo, hi):
-        return jitted(state, total, slab, jnp.int32(lo), jnp.int32(hi))
+        args = (state, total, slab, jnp.int32(lo), jnp.int32(hi))
+        if _specs[0] is None:
+            _specs[0] = _arg_specs(args)
+        return jitted(*args)
     superstep.traces = traces
+    superstep.cost_analysis = _cost_analysis_hook(jitted, _specs)
     return superstep
 
 

@@ -77,9 +77,11 @@ class StepTimer:
         fence group absorbs trace+compile (near-zero when the persistent
         compilation cache hits — the pair makes cache effectiveness and
         steady-state dispatch separately visible), ``run_s`` covers the
-        counted steady-state steps."""
-        return {"compile_warmup_s": round(self.warmup_s, 3),
-                "run_s": round(self.elapsed, 3), "steps": self.steps}
+        counted steady-state steps. FULL precision: downstream MFU math
+        divides by ``run_s``, and 3-decimal rounding quantized fast CPU
+        test runs to zero — round only for human display."""
+        return {"compile_warmup_s": self.warmup_s,
+                "run_s": self.elapsed, "steps": self.steps}
 
     def steps_per_sec(self) -> float:
         return self.steps / self.elapsed if self.elapsed > 0 else 0.0
@@ -100,39 +102,66 @@ class MetricsLogger:
     per-record ``write()+flush()`` put filesystem latency — NFS-mounted
     save dirs are the norm on pods — inside the step loop's timed fence
     windows, where it read as training slowdown in ``StepTimer``.
+
+    CRASH SAFETY: buffering must not mean "lost on death" — the runs
+    where metrics matter most are exactly the ones that die between
+    flushes. An ``atexit`` hook flushes the tail on any interpreter exit
+    (unhandled exception included), and the flight-recorder watchdog
+    flushes from its stall dump; a lock makes that cross-thread flush
+    safe against the main thread's concurrent ``log()``.
     """
     path: Optional[str] = None
     _fh: Optional[IO] = None
     history: List[Dict] = field(default_factory=list)
     _buf: List[str] = field(default_factory=list)
 
+    def __post_init__(self) -> None:
+        import atexit
+        import threading
+        self._lock = threading.Lock()
+        # bound method identity is stable, so close() can unregister it
+        atexit.register(self.flush)
+
     def log(self, **kv) -> None:
         if jax.process_index() != 0:
             return
         rec = dict(ts=time.time(), **kv)
-        self.history.append(rec)
-        if self.path:
-            self._buf.append(json.dumps(rec))
+        with self._lock:
+            self.history.append(rec)
+            if self.path:
+                self._buf.append(json.dumps(rec))
 
     def flush(self) -> None:
         """Write buffered records out — called off the step path (epoch
-        ends, run end) so JSONL I/O never lands inside a timed window."""
-        if not (self.path and self._buf):
-            return
-        if self._fh is None:
-            d = os.path.dirname(self.path)
-            if d:
-                os.makedirs(d, exist_ok=True)
-            self._fh = open(self.path, "a")
-        self._fh.write("\n".join(self._buf) + "\n")
-        self._fh.flush()
-        self._buf.clear()
+        ends, run end), from the watchdog's stall dump, and from the
+        atexit hook, so JSONL I/O never lands inside a timed window and
+        a dying run never loses its buffered tail."""
+        with self._lock:
+            if not (self.path and self._buf):
+                return
+            if self._fh is None:
+                d = os.path.dirname(self.path)
+                if d:
+                    os.makedirs(d, exist_ok=True)
+                self._fh = open(self.path, "a")
+            self._fh.write("\n".join(self._buf) + "\n")
+            self._fh.flush()
+            self._buf.clear()
 
     def close(self) -> None:
+        import atexit
         self.flush()
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+        with self._lock:
+            if self._fh:
+                self._fh.close()
+                self._fh = None
+        # a closed logger must not be re-flushed at interpreter exit
+        # (the file handle is gone; long-lived processes would also leak
+        # one registration per run otherwise)
+        try:
+            atexit.unregister(self.flush)
+        except Exception:
+            pass
 
 
 @dataclass

@@ -1,0 +1,100 @@
+"""Flight-record dump: the post-mortem a hung pod run leaves behind.
+
+The dominant real-world failure mode of a pod acceptance test is not a
+crash but a *hang* — one worker stalls in a collective and every other
+rank blocks silently until the launcher's outer timeout, leaving zero
+evidence of which host or which step died. :func:`dump_flight_record`
+writes that evidence while the process is still alive: faulthandler
+stacks of every thread (the wedged collective's frame is right there),
+per-device ``memory_stats()``, the last progress beacon, and the tail of
+the in-memory metrics history — one JSON artifact per worker
+(``flightrec.worker<i>``), written atomically so a kill mid-dump cannot
+leave a half-parsed file.
+
+The writer must itself be hang-proof: it takes no locks it does not own,
+touches the device runtime only through ``memory_stats()`` (a host-side
+query that does not enqueue device work), and swallows per-section
+failures so a broken backend cannot turn the diagnosis into a second
+hang.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import json
+import os
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+FLIGHTREC_SCHEMA_VERSION = 1
+
+
+def thread_stacks() -> str:
+    """All threads' stacks as text, via :mod:`faulthandler` (the signal-
+    safe dumper — it walks frames without allocating, so it works even
+    when the main thread is wedged holding internal locks). faulthandler
+    needs a real file descriptor, so route it through a TemporaryFile."""
+    try:
+        with tempfile.TemporaryFile(mode="w+") as tf:
+            faulthandler.dump_traceback(file=tf, all_threads=True)
+            tf.seek(0)
+            return tf.read()
+    except Exception as e:   # a diagnosis tool must not raise
+        return f"<thread stack dump failed: {e!r}>"
+
+
+def collect_memory_stats() -> List[Dict[str, Any]]:
+    """Per-local-device ``memory_stats()`` snapshots (None entries on
+    backends that report nothing, e.g. CPU)."""
+    out: List[Dict[str, Any]] = []
+    try:
+        import jax
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            out.append({"id": d.id, "kind": getattr(d, "device_kind", "?"),
+                        "stats": stats})
+    except Exception:
+        pass
+    return out
+
+
+def dump_flight_record(path: str, *, reason: str,
+                       progress: Optional[Dict[str, Any]] = None,
+                       stall_s: Optional[float] = None,
+                       last_metrics: Optional[List[Dict]] = None,
+                       extra: Optional[Dict[str, Any]] = None) -> str:
+    """Write one flight-record artifact to ``path`` and return the path.
+
+    The artifact is a single JSON object (CI parses it) with:
+    ``reason`` (why the dump fired), ``progress`` (the last beacon:
+    step/epoch/phase/ts), ``thread_stacks`` (faulthandler text),
+    ``memory_stats`` (per device), ``last_metrics`` (tail of the
+    in-memory record history), and any ``extra`` observer state (HBM
+    watermarks). Atomic write: tmp + ``os.replace``."""
+    payload: Dict[str, Any] = {
+        "schema": FLIGHTREC_SCHEMA_VERSION,
+        "reason": reason,
+        "ts": time.time(),
+        "pid": os.getpid(),
+        "python": sys.version.split()[0],
+        "stall_s": stall_s,
+        "progress": progress or {},
+        "thread_stacks": thread_stacks(),
+        "memory_stats": collect_memory_stats(),
+        "last_metrics": list(last_metrics or []),
+    }
+    if extra:
+        payload["extra"] = extra
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f, indent=1, default=str)
+    os.replace(tmp, path)
+    return path

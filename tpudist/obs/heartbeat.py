@@ -1,0 +1,177 @@
+"""Per-process heartbeat beacon + stall watchdog.
+
+The reference's only liveness signal was CI's 10-second poll of a
+``job_status.txt`` that is written *after* the job ends (SURVEY.md
+§5.1/§5.5) — a hung worker produced nothing at all until the launcher's
+outer timeout. The :class:`FlightRecorder` closes that gap from inside
+the process:
+
+  * the train loop calls :meth:`note_progress` at step boundaries (an
+    attribute assignment — nanoseconds, nothing fenced, no device work);
+  * a daemon thread writes a small JSON **beacon**
+    (``heartbeat.worker<i>``: step, epoch, phase, ts) every few seconds —
+    an operator ssh'd into any worker can see where it is *right now*;
+  * the same thread watches the progress counter: no step progress for
+    ``stall_timeout_s`` ⇒ it dumps a flight record (thread stacks,
+    memory stats, last-N metrics — :mod:`tpudist.obs.flightrec`) and
+    flushes the buffered metrics stream, all *before* the launcher kills
+    the job.
+
+The watchdog thread runs even while the main thread is wedged inside a
+blocked collective: JAX blocks in C with the GIL released, so the timer
+keeps ticking — which is the entire point.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+from tpudist.obs import flightrec
+
+# beacon/watchdog wake period is derived from the stall window (a 0.5 s
+# test window needs sub-second checks; a production 300 s window does
+# not) and clamped to these bounds
+_MIN_PERIOD_S = 0.05
+_MAX_PERIOD_S = 2.0
+
+
+class FlightRecorder:
+    """Heartbeat beacon + stall watchdog for one process.
+
+    Parameters:
+      * ``out_dir`` — where ``heartbeat.worker<i>`` and
+        ``flightrec.worker<i>`` land (the launcher collects this
+        directory when a run times out).
+      * ``stall_timeout_s`` — no step progress for this long ⇒ dump a
+        flight record. ``0`` disables the watchdog (the beacon still
+        beats).
+      * ``process_index`` — names the artifacts; cached at construction
+        so the watchdog thread never calls into jax.
+      * ``metrics`` — a ``MetricsLogger``; the stall dump embeds the
+        tail of its history and flushes its buffer (the records matter
+        most in exactly the runs that die).
+      * ``extra_state`` — optional callable returning a dict folded into
+        the dump (the HBM sampler's watermarks ride along here).
+    """
+
+    def __init__(self, out_dir: str, *, stall_timeout_s: float = 300.0,
+                 process_index: int = 0, metrics: Any = None,
+                 extra_state: Optional[Callable[[], Dict]] = None,
+                 last_n_metrics: int = 50):
+        if stall_timeout_s < 0:
+            raise ValueError(
+                f"stall_timeout_s must be >= 0, got {stall_timeout_s}")
+        self.out_dir = out_dir
+        self.stall_timeout_s = float(stall_timeout_s)
+        self.process_index = process_index
+        self.metrics = metrics
+        self.extra_state = extra_state
+        self.last_n_metrics = last_n_metrics
+        self.beacon_path = os.path.join(
+            out_dir, f"heartbeat.worker{process_index}")
+        self.flightrec_path = os.path.join(
+            out_dir, f"flightrec.worker{process_index}")
+        self.dumps = 0          # flight records written (tests read this)
+        self.beacons = 0        # beacon writes (tests read this)
+        # progress is replaced wholesale (never mutated) so the watchdog
+        # thread always reads a consistent snapshot without a lock
+        self._progress: Dict[str, Any] = {
+            "phase": "init", "step": -1, "epoch": -1, "ts": time.time(),
+            "process_index": process_index, "pid": os.getpid()}
+        self._count = 0
+        self._stop = threading.Event()
+        period = _MAX_PERIOD_S
+        if self.stall_timeout_s > 0:
+            period = min(_MAX_PERIOD_S,
+                         max(_MIN_PERIOD_S, self.stall_timeout_s / 4.0))
+        self._period_s = period
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudist-flightrec", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- hot path
+    def note_progress(self, **kv: Any) -> None:
+        """Record step progress. Called from the train loop's hot path:
+        two attribute assignments, no I/O, no locks, no device work."""
+        kv["ts"] = time.time()
+        self._progress = {**self._progress, **kv}
+        self._count += 1
+
+    @property
+    def progress(self) -> Dict[str, Any]:
+        return self._progress
+
+    # ------------------------------------------------- watchdog thread
+    def _loop(self) -> None:
+        last_count = self._count
+        last_change = time.monotonic()
+        dumped_this_stall = False
+        while not self._stop.wait(self._period_s):
+            self._write_beacon()
+            now = time.monotonic()
+            if self._count != last_count:
+                last_count = self._count
+                last_change = now
+                dumped_this_stall = False   # progress resumed; re-arm
+                continue
+            if (self.stall_timeout_s > 0 and not dumped_this_stall
+                    and now - last_change >= self.stall_timeout_s):
+                self.dump(reason="stall",
+                          stall_s=round(now - last_change, 3))
+                dumped_this_stall = True
+
+    def _write_beacon(self) -> None:
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            tmp = f"{self.beacon_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump({**self._progress, "beacon_ts": time.time()}, f)
+            os.replace(tmp, self.beacon_path)
+            self.beacons += 1
+        except Exception:
+            # the beacon is best-effort; a full disk must not kill the
+            # watchdog (the flight record is the part that matters)
+            pass
+
+    # ----------------------------------------------------------- dump
+    def dump(self, reason: str = "manual",
+             stall_s: Optional[float] = None) -> str:
+        """Write the flight record now (the watchdog calls this on
+        stall; the launcher-facing contract is the artifact's existence,
+        so it is also callable directly for drills/tests)."""
+        history = []
+        if self.metrics is not None:
+            try:
+                history = list(self.metrics.history)[-self.last_n_metrics:]
+            except Exception:
+                pass
+        extra = None
+        if self.extra_state is not None:
+            try:
+                extra = self.extra_state()
+            except Exception:
+                extra = None
+        path = flightrec.dump_flight_record(
+            self.flightrec_path, reason=reason, progress=self._progress,
+            stall_s=stall_s, last_metrics=history, extra=extra)
+        if self.metrics is not None:
+            # the buffered JSONL stream would otherwise die with the run
+            # — these are the records that matter most (satellite:
+            # crash-safety for buffered metrics). Flushed before the
+            # dumps counter ticks: the counter is the "dump complete"
+            # signal watchers key off.
+            try:
+                self.metrics.flush()
+            except Exception:
+                pass
+        self.dumps += 1
+        return path
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self._write_beacon()   # final beacon: phase as of shutdown

@@ -1,0 +1,88 @@
+"""Per-host step-time aggregation: straggler detection at epoch ends.
+
+On a pod, one slow host drags every collective down to its pace — the
+job's steps/s quietly becomes the straggler's steps/s and nothing in a
+global aggregate says which host it was. At each epoch end every process
+contributes its steady-state step-wall stats for that epoch via
+``process_allgather`` (the epoch end is already a synchronization point
+— all hosts arrive together, so the collective adds no new hang risk
+beyond the watchdog's coverage), and rank 0 emits a ``kind=hosts``
+record listing every host's mean step time plus a three-valued
+``straggler_status`` (SUCCESS / FAIL / UNGATEABLE — the
+:mod:`tpudist.verdict` pattern): FAIL when any host's step time exceeds
+the pod median by ``TPUDIST_STRAGGLER_FACTOR``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from tpudist import verdict as verdict_lib
+
+
+class HostStepStats:
+    """Epoch-over-epoch per-host step-time tracker.
+
+    Holds the last epoch's straggler verdict in ``status`` (folded into
+    the run-end ``kind=timing`` record) and the deltas needed to turn
+    the run-long ``StepTimer`` aggregate into per-epoch means.
+    """
+
+    def __init__(self, process_index: int = 0, process_count: int = 1):
+        self.process_index = process_index
+        self.process_count = process_count
+        self.status = verdict_lib.UNGATEABLE
+        self.last_hosts: List[Dict[str, Any]] = []
+        self._last_steps = 0
+        self._last_elapsed = 0.0
+
+    def _local_epoch_stats(self, timer) -> tuple[int, float]:
+        """This epoch's (steps, mean step seconds) from the run-long
+        timer aggregate; warmup-only epochs report (0, 0)."""
+        d_steps = timer.steps - self._last_steps
+        d_elapsed = timer.elapsed - self._last_elapsed
+        self._last_steps = timer.steps
+        self._last_elapsed = timer.elapsed
+        mean = d_elapsed / d_steps if d_steps > 0 else 0.0
+        return d_steps, mean
+
+    def _gather(self, steps: int, mean: float) -> np.ndarray:
+        """(n_hosts, 3) rows of [process_index, steps, step_s_mean]."""
+        local = np.asarray(
+            [float(self.process_index), float(steps), mean], np.float32)
+        if self.process_count == 1:
+            return local[None, :]
+        from jax.experimental import multihost_utils
+        return np.asarray(multihost_utils.process_allgather(local))
+
+    def epoch_end(self, epoch: int, timer, metrics) -> str:
+        """Aggregate this epoch's per-host step stats; log the
+        ``kind=hosts`` record (rank 0 — MetricsLogger gates itself) and
+        update ``status``. ALL processes must call this (it contains a
+        collective on multi-host runs)."""
+        steps, mean = self._local_epoch_stats(timer)
+        try:
+            rows = self._gather(steps, mean)
+        except Exception:
+            # observability must never fail a run: a backend whose
+            # cross-process collectives are broken will fail training on
+            # its own terms — degrade to the local row (status stays
+            # UNGATEABLE with a single reporter)
+            rows = np.asarray(
+                [[float(self.process_index), float(steps), mean]],
+                np.float32)
+        hosts = [{"process": int(r[0]), "steps": int(r[1]),
+                  "step_s_mean": float(r[2])} for r in rows]
+        means = [h["step_s_mean"] for h in hosts if h["steps"] > 0]
+        median = float(np.median(means)) if means else 0.0
+        self.status = verdict_lib.straggler_status(means)
+        self.last_hosts = hosts
+        worst = max(means) if means else 0.0
+        metrics.log(kind="hosts", epoch=epoch, hosts=hosts,
+                    median_step_s=median, worst_step_s=worst,
+                    straggler_ratio=(worst / median if median > 0
+                                     else None),
+                    straggler_status=self.status)
+        return self.status

@@ -326,6 +326,70 @@ def check_staging_stream():
           flush=True)
 
 
+def check_flight_recorder():
+    """The flight-recorder pipeline end-to-end with a DELIBERATELY
+    wedged step: progress beacons flow while steps advance, then the
+    'step' blocks past the stall window (the single-host stand-in for a
+    worker stuck in a collective) and the watchdog must dump a
+    flight-record artifact — containing thread stacks with the wedged
+    frame, per-device memory stats, and the last progress/metrics —
+    BEFORE the launcher's outer timeout would kill the job. Writes into
+    $TPUDIST_OBS_DIR when set (CI uploads the artifacts), else a temp
+    dir."""
+    import json
+    import os
+    import tempfile
+    import time as _t
+
+    from tpudist.metrics import MetricsLogger
+    from tpudist.obs import FlightRecorder
+
+    out_dir = os.environ.get("TPUDIST_OBS_DIR") or tempfile.mkdtemp(
+        prefix="tpudist_obs_")
+    stall_s = 0.5
+    metrics = MetricsLogger(path=os.path.join(out_dir, "metrics.jsonl"))
+    rec = FlightRecorder(out_dir, stall_timeout_s=stall_s,
+                         process_index=jax.process_index(),
+                         metrics=metrics)
+    try:
+        for step in range(3):            # healthy steps: beacon advances
+            rec.note_progress(phase="train", epoch=0, step=step)
+            metrics.log(kind="step", step=step, loss=1.0 / (step + 1))
+            _t.sleep(0.05)
+        assert rec.dumps == 0, "watchdog fired on a healthy run"
+
+        def wedged_step():               # the hang: no progress notes
+            deadline = _t.monotonic() + 20 * stall_s
+            while rec.dumps == 0 and _t.monotonic() < deadline:
+                _t.sleep(0.05)
+        wedged_step()
+        assert rec.dumps >= 1, "watchdog never fired on the wedged step"
+        # the stall dump itself must have flushed the buffered metrics
+        # (crash safety) — asserted BEFORE close(), whose flush would
+        # otherwise mask a missing dump-time flush
+        with open(os.path.join(out_dir, "metrics.jsonl")) as f:
+            assert len(f.readlines()) >= 3, \
+                "stall dump did not flush metrics"
+    finally:
+        rec.close()
+        metrics.close()
+
+    with open(rec.flightrec_path) as f:
+        art = json.load(f)               # must parse: CI asserts this too
+    assert art["reason"] == "stall", art["reason"]
+    assert art["progress"]["step"] == 2 and art["progress"]["phase"] == \
+        "train", art["progress"]
+    assert "wedged_step" in art["thread_stacks"], \
+        "stall dump missing the wedged frame"
+    assert isinstance(art["memory_stats"], list)
+    assert art["last_metrics"] and art["last_metrics"][-1]["step"] == 2
+    with open(rec.beacon_path) as f:
+        beacon = json.load(f)
+    assert beacon["step"] == 2
+    print(f"  flight record: {rec.flightrec_path} "
+          f"({len(art['thread_stacks'])} B of stacks)", flush=True)
+
+
 def check_train_step_smoke():
     """One bf16 train step of the tiny transformer: finite, decreasing."""
     _train_smoke(dict(name="transformer", vocab_size=512, n_layers=2,
@@ -349,6 +413,7 @@ CHECKS = [
     check_flash_attention_gqa_long_context,
     check_ring_flash_merge,
     check_staging_stream,
+    check_flight_recorder,
     check_train_step_smoke,
     check_moe_smoke,
 ]
@@ -375,8 +440,20 @@ def main(argv=None) -> int:
         print(f"selfcheck: backend is {backend!r}, not tpu — refusing "
               f"(pass --allow-cpu to run interpreted for development)")
         return 2
+    checks = CHECKS
+    if "--only" in argv:
+        # run a single named check (CI's forced-stall flight-recorder
+        # drill uses this; an unknown or missing name is an error, not
+        # an empty green run)
+        idx = argv.index("--only") + 1
+        name = argv[idx] if idx < len(argv) else None
+        checks = [fn for fn in CHECKS if fn.__name__ == name]
+        if not checks:
+            print(f"selfcheck: no check named {name!r} "
+                  f"(have: {', '.join(fn.__name__ for fn in CHECKS)})")
+            return 2
     failed = 0
-    for fn in CHECKS:
+    for fn in checks:
         t0 = time.perf_counter()
         try:
             fn()
@@ -386,7 +463,7 @@ def main(argv=None) -> int:
             failed += 1
             print(f"FAIL {fn.__name__}", flush=True)
             traceback.print_exc()
-    n = len(CHECKS)
+    n = len(checks)
     print(f"selfcheck: {n - failed}/{n} passed", flush=True)
     return 1 if failed else 0
 

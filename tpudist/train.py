@@ -30,6 +30,7 @@ import numpy as np
 from tpudist import checkpoint as ckpt_lib
 from tpudist import data as data_lib
 from tpudist import engine as engine_lib
+from tpudist import obs as obs_lib
 from tpudist import verdict as verdict_lib
 from tpudist import config as config_lib
 from tpudist.config import TrainConfig, parse_args
@@ -151,6 +152,13 @@ def run(cfg: TrainConfig) -> float:
     timer = StepTimer()
     last_avg = float("nan")
 
+    # the flight recorder: heartbeat beacon + stall watchdog + HBM
+    # watermark sampler + per-host straggler tracking — a hung or slow
+    # pod run leaves a diagnosis (flightrec.worker<i>), not a timeout
+    observer = obs_lib.PodObserver.from_config(
+        cfg, metrics=metrics, process_index=ctx.process_index,
+        process_count=ctx.process_count)
+
     # one manager for the whole run: async saves overlap the next epoch's
     # steps (the old save-per-call shape implied a synchronous drain)
     ckpt = ckpt_lib.Checkpointer(cfg.save_dir, use_async=not cfg.ckpt_sync)
@@ -167,9 +175,11 @@ def run(cfg: TrainConfig) -> float:
                                    eval_fn, eval_batch, ckpt,
                                    superstep=superstep, k=k,
                                    budget_bytes=budget_bytes,
-                                   staging=staging)
+                                   staging=staging, observer=observer)
     finally:
+        observer.note_progress(phase="shutdown")
         ckpt.close()   # drain outstanding async writes before exiting
+        observer.close()  # stop watchdog/sampler threads, final beacon
         metrics.close()  # flush the buffered JSONL stream even on failure
 
     log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
@@ -194,9 +204,24 @@ def run(cfg: TrainConfig) -> float:
              f"overlap {overlap:.3f} "
              f"(exposed wait {staging.wait_s:.2f}s of "
              f"{timer.elapsed:.2f}s run)")
+    # roofline + watermark + straggler slice of the timing record: MFU
+    # from the compiled program's own cost analysis (obs.mfu), the HBM
+    # high-water mark, and the last epoch's per-host straggler verdict
+    obs_fields = observer.timing_fields(
+        timer, superstep if superstep is not None else train_step)
+    if obs_fields.get("mfu") is not None:
+        log0(f"tpudist: mfu {100 * obs_fields['mfu']:.2f}% "
+             f"({obs_fields['achieved_tflops_per_chip']:.2f} of "
+             f"{obs_fields['peak_tflops']:.0f} TFLOP/s/chip, "
+             f"{obs_fields['achieved_gbps_per_chip'] or 0:.2f} GB/s)")
+    if obs_fields.get("hbm_peak_bytes"):
+        log0(f"tpudist: hbm peak {obs_fields['hbm_peak_bytes'] / 2**20:.1f}"
+             f" MB ({obs_fields['hbm_source']})"
+             + (f", {100 * obs_fields['hbm_peak_fraction']:.1f}% of device"
+                if obs_fields.get("hbm_peak_fraction") else ""))
     metrics.log(kind="timing", steps_per_dispatch=k, **timer.split(),
                 **staging.split(), staging_overlap_fraction=overlap,
-                staging_status=staging_verdict)
+                staging_status=staging_verdict, **obs_fields)
     log0("Training completed.")  # parity banner (train.py:128)
     metrics.close()
     return last_avg
@@ -204,7 +229,7 @@ def run(cfg: TrainConfig) -> float:
 
 def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                      n_steps, epoch, metrics, timer, ckpt, budget_bytes,
-                     staging):
+                     staging, observer=None):
     """One epoch under superstep dispatch with bounded-memory staging.
 
     ``sharding.plan_slabs`` cuts the epoch into ``(slab_steps, batch,
@@ -298,6 +323,13 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
             end = gstart + hi       # true global steps completed
             counted += hi - lo
             pending += hi - lo
+            if observer is not None:
+                # hot path: two attribute writes, nothing fenced — the
+                # watchdog's liveness signal (the dispatch above is
+                # async, but a wedged device wedges the NEXT fence, and
+                # the beacon's step stops advancing with it)
+                observer.note_progress(phase="train", epoch=epoch,
+                                       step=end)
             if not dispatched:
                 dispatched = True
                 if timer.warming:
@@ -344,7 +376,7 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
 def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 start_epoch, start_step_in_epoch, metrics, timer, eval_fn,
                 eval_batch, ckpt, superstep=None, k=1, budget_bytes=None,
-                staging=None):
+                staging=None, observer=None):
     last_avg = float("nan")
     staging = StagingStats() if staging is None else staging
     for epoch in range(start_epoch, cfg.epochs):
@@ -368,10 +400,11 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
         if superstep is not None:
             state, total, counted, pending = _superstep_epoch(
                 cfg, k, mesh, state, superstep, plan, first, n_steps,
-                epoch, metrics, timer, ckpt, budget_bytes, staging)
+                epoch, metrics, timer, ckpt, budget_bytes, staging,
+                observer=observer)
             last_avg = _epoch_end(cfg, state, total, counted, pending,
                                   n_steps, epoch, metrics, timer, eval_fn,
-                                  eval_batch, ckpt)
+                                  eval_batch, ckpt, observer=observer)
             continue
         batches = plan.slab(0, n_steps)
         for i in range(first, n_steps):
@@ -380,6 +413,9 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             total = loss if total is None else total + loss
             counted += 1
             pending += 1
+            if observer is not None:
+                observer.note_progress(phase="train", epoch=epoch,
+                                       step=i + 1)
             if i == first and timer.warming:
                 # fence the first step alone so the timer's warmup absorbs
                 # exactly the trace+compile cost, not a whole fence group —
@@ -419,15 +455,16 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 timer.start()
         last_avg = _epoch_end(cfg, state, total, counted, pending, n_steps,
                               epoch, metrics, timer, eval_fn, eval_batch,
-                              ckpt)
+                              ckpt, observer=observer)
 
     return last_avg
 
 
 def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
-               timer, eval_fn, eval_batch, ckpt):
+               timer, eval_fn, eval_batch, ckpt, observer=None):
     """Epoch tail shared by per-step and superstep dispatch: drain, Avg
-    line, eval, epoch metrics, epoch-end checkpoint, fault injection."""
+    line, eval, per-host straggler aggregation, epoch metrics, epoch-end
+    checkpoint, fault injection."""
     # epoch-end fence: one host transfer drains the queue
     # (on a resumed partial epoch, Avg covers the post-resume steps)
     last_avg = float(total) / max(counted, 1) if counted else float("nan")
@@ -435,8 +472,20 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
     # parity line, parsed by humans and tests alike — 1-based with the
     # reference's exact width-2 formatting (train.py:99,121)
     log0(f"Epoch {epoch + 1:2d} finished. Avg loss: {last_avg:.4f}")
+    if observer is not None:
+        observer.note_progress(phase="eval", epoch=epoch, step=n_steps)
     eval_loss = float(eval_fn(state, eval_batch))
     log0(f"Epoch {epoch + 1:2d} eval loss: {eval_loss:.4f}")
+    # per-host step-time aggregation (kind=hosts record + straggler
+    # verdict): a collective — every process calls it, at a point where
+    # all hosts are synchronized by construction (the epoch fence above)
+    if observer is not None:
+        status = observer.epoch_end(epoch, timer, metrics)
+        if status == verdict_lib.FAIL:
+            worst = max(h["step_s_mean"] for h in observer.hosts.last_hosts
+                        if h["steps"] > 0)
+            log0(f"tpudist: straggler fail: worst host step "
+                 f"{worst * 1e3:.2f} ms vs pod median — see kind=hosts")
     # steps_counted < n_steps marks a resumed partial epoch: the
     # stdout Avg then covers only the post-resume steps, so the
     # record is self-describing for loss-parity dashboards (r3
@@ -448,6 +497,8 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
                 steps_per_sec_per_chip=timer.steps_per_sec_per_chip())
     # resume position: next epoch from its first batch. Async: blocks
     # only for the device->host snapshot; the write overlaps epoch+1.
+    if observer is not None:
+        observer.note_progress(phase="ckpt", epoch=epoch)
     ckpt.save(state, epoch=epoch + 1, step_in_epoch=0)
     metrics.log(kind="ckpt", epoch=epoch, step=int(state.step),
                 step_in_epoch=0, save_ms=round(ckpt.last_save_ms, 1))
@@ -472,10 +523,30 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     cfg = parse_args(argv)
     maybe_enable_compilation_cache(cfg.compilation_cache_dir)
     verdict_path = os.environ.get("TPUDIST_VERDICT_PATH")
+    # The launcher bounds the job with `timeout` → SIGTERM, which by
+    # default kills CPython WITHOUT atexit or finally blocks — exactly
+    # the death mode that loses the buffered metrics tail and the fail
+    # verdict. Convert it into an orderly exception so run()'s finally
+    # (metrics flush, observer close, ckpt drain) and the verdict chain
+    # below still execute; `timeout`'s follow-up SIGKILL remains the
+    # backstop if even that wedges. Best-effort: signal handlers only
+    # install from the main thread (in-process test harnesses may not
+    # be one).
+    import signal
+
+    def _sigterm(signum, frame):
+        raise SystemExit(128 + signum)
+    try:
+        prev_sigterm = signal.signal(signal.SIGTERM, _sigterm)
+    except (ValueError, OSError):
+        prev_sigterm = None
     ok = False
     try:
         run(cfg)
         ok = True
+    except SystemExit:
+        print("tpudist: training terminated by signal", file=sys.stderr,
+              flush=True)
     except Exception as e:
         print(f"tpudist: training failed: {e!r}", file=sys.stderr, flush=True)
     finally:
@@ -508,6 +579,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         # a coordinated shutdown) would hang on it or race the abandoned
         # aggregation allgather; the verdict is written, just exit and let
         # the launcher reap the slice (r3 review finding)
+        if prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, prev_sigterm)
+            except (ValueError, OSError):
+                pass
     return 0 if ok and all_ok else 1
 
 

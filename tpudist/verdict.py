@@ -32,21 +32,61 @@ UNGATEABLE = "ungateable"
 # before a streamed run is FLAGGED: below this, host→device transfer is
 # not hiding behind compute and the pod is silently input-bound.
 # Advisory, not exit-code-bearing — training that completes with slow
-# staging is a perf finding, not a correctness failure.
-STAGING_OVERLAP_MIN = float(os.environ.get("TPUDIST_STAGING_OVERLAP_MIN",
-                                           "0.5"))
+# staging is a perf finding, not a correctness failure. The env override
+# TPUDIST_STAGING_OVERLAP_MIN is read at CALL time, not import time, so
+# per-run overrides (and tests) take effect without a module reload.
+STAGING_OVERLAP_MIN = 0.5
+
+# A host whose steady-state step time exceeds the pod median by this
+# factor is a straggler: every collective runs at its pace, so the whole
+# job's steps/s silently becomes that host's steps/s. Advisory, like the
+# staging gate; env override TPUDIST_STRAGGLER_FACTOR (call time).
+STRAGGLER_FACTOR = 1.25
 
 
-def staging_status(streamed: bool, overlap_fraction) -> str:
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        return default
+
+
+def staging_status(streamed: bool, overlap_fraction,
+                   min_overlap: float | None = None) -> str:
     """Three-valued staging verdict for the run log + metrics stream:
     UNGATEABLE when the epoch took the full-staging fast path (no
     steady-state H2D to hide), else SUCCESS/FAIL by whether the measured
-    overlap fraction clears :data:`STAGING_OVERLAP_MIN` — so a pod run
-    failing to hide H2D is flagged in the artifact stream, not silently
-    slow."""
+    overlap fraction clears the threshold ($TPUDIST_STAGING_OVERLAP_MIN,
+    default :data:`STAGING_OVERLAP_MIN`) — so a pod run failing to hide
+    H2D is flagged in the artifact stream, not silently slow."""
+    if min_overlap is None:
+        min_overlap = _env_float("TPUDIST_STAGING_OVERLAP_MIN",
+                                 STAGING_OVERLAP_MIN)
     if not streamed or overlap_fraction is None:
         return UNGATEABLE
-    return SUCCESS if overlap_fraction >= STAGING_OVERLAP_MIN else FAIL
+    return SUCCESS if overlap_fraction >= min_overlap else FAIL
+
+
+def straggler_status(step_s_means, factor: float | None = None) -> str:
+    """Three-valued per-host straggler verdict (tpudist.obs.hoststats):
+    UNGATEABLE with fewer than two hosts reporting steady-state step
+    times (nothing to compare — a single-host run must not read as a
+    straggler regression), else FAIL when any host's mean step time
+    exceeds the pod median by the threshold factor
+    ($TPUDIST_STRAGGLER_FACTOR, default :data:`STRAGGLER_FACTOR`)."""
+    import statistics
+    if factor is None:
+        factor = _env_float("TPUDIST_STRAGGLER_FACTOR", STRAGGLER_FACTOR)
+    valid = [float(s) for s in step_s_means if s and s > 0]
+    if len(valid) < 2:
+        return UNGATEABLE
+    median = statistics.median(valid)
+    if median <= 0:
+        return UNGATEABLE
+    return FAIL if max(valid) > factor * median else SUCCESS
 
 
 def _write(path: str, content: str) -> None:
