@@ -246,109 +246,30 @@ def measure(cfg: TrainConfig, iters: int = 60) -> dict:
 # --------------------------------------------------------- dispatch sweep
 
 
+def _sweep_plan(cfg, n_steps: int):
+    """Epoch-0 plan of the sweeps' tiny-MLP dataset (the probe harness
+    consumes EpochPlans — the same input contract the train loop has)."""
+    return data.plan_epoch(
+        data.make_synthetic_data(n_steps * cfg.batch_size,
+                                 cfg.data.n_features, cfg.data.seed),
+        batch_size=cfg.batch_size, seed=cfg.seed, epoch=0)
+
+
 def _dispatch_cell(cfg, mesh, k: int, n_steps: int, repeats: int) -> dict:
     """ms/step of the tiny-MLP train loop at superstep length k (k=1 =
     the per-step dispatch path, including its per-step put_batch — the
-    real thing the superstep replaces)."""
-    from tpudist.parallel import sharding as shd
-    x, y = data.make_synthetic_data(n_steps * cfg.batch_size,
-                                    cfg.data.n_features, cfg.data.seed)
-    bx, by = data.shard_epoch(x, y, batch_size=cfg.batch_size,
-                              seed=cfg.seed, epoch=0)
-    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    real thing the superstep replaces). The compile/warmup/time-n-steps
+    loop is tune.probe's — the sweep and the autotuner share one trial
+    protocol, so BENCH_DISPATCH rows and probe trials are comparable."""
+    from tpudist.tune import probe
+    runner = probe.EpochRunner(cfg, mesh, k, _sweep_plan(cfg, n_steps),
+                               n_steps)
     sampler = HbmSampler(period_s=0)   # manual sampling brackets the cell
-
-    if k == 1:
-        step = engine.make_train_step(cfg, mesh)
-
-        def run_epoch(state):
-            loss = None
-            for i in range(n_steps):
-                state, loss = step(state, (bx[i], by[i]))
-            return state, loss
-    else:
-        superstep = engine.make_superstep(cfg, mesh, k)
-        padded = -(-n_steps // k) * k
-        staged = shd.put_epoch(mesh, data.pad_steps((bx, by), padded))
-
-        def run_epoch(state):
-            import jax.numpy as jnp
-            total = jnp.zeros((), jnp.float32)
-            loss = None
-            for j in range(padded // k):
-                gstart = j * k
-                if gstart >= n_steps:
-                    break
-                hi = min(n_steps - gstart, k)
-                slab = jax.tree.map(lambda a: a[gstart:gstart + k], staged)
-                state, total, loss = superstep(state, total, slab, 0, hi)
-            return state, loss
-
-    state, loss = run_epoch(state)            # trace + compile + warm
-    jax.device_get(loss)
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        state, loss = run_epoch(state)
-        jax.device_get(loss)                  # fence
-        times.append((time.perf_counter() - t0) * 1000 / n_steps)
+    _, times, _ = probe.time_runner(runner, repeats=repeats)
     ms = statistics.median(times)
-    dispatch_fn = step if k == 1 else superstep
     return {"k": k, "step_ms": round(ms, 4),
             "steps_per_sec": round(1000 / ms, 1),
-            **_sweep_obs_fields(dispatch_fn, ms, sampler)}
-
-
-def _staging_runner(cfg, mesh, k: int, n_steps: int, budget_bytes):
-    """Build one staging mode's epoch runner: budget None = the
-    full-epoch fast path, else double-buffered streaming exactly as
-    train._superstep_epoch stages it. Returns ``(run_epoch, state,
-    superstep, splan)``; the sweep interleaves the modes' timed epochs
-    so host drift cancels out of the ratio. The superstep compile count
-    — the whole run, trailing partial slab included — must stay at
-    ONE."""
-    import jax.numpy as jnp
-
-    from tpudist.parallel import sharding as shd
-    x, y = data.make_synthetic_data(n_steps * cfg.batch_size,
-                                    cfg.data.n_features, cfg.data.seed)
-    plan = data.plan_epoch((x, y), batch_size=cfg.batch_size, seed=cfg.seed,
-                           epoch=0)
-    batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
-    step_bytes = max(1, plan.bytes_per_step // batch_shards)
-    splan = shd.plan_slabs(n_steps, k, step_bytes, budget_bytes)
-    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
-    superstep = engine.make_superstep(cfg, mesh, k)
-    S = splan.slab_steps
-
-    def stage(s):
-        start, stop = s * S, min(n_steps, s * S + S)
-        pad_to = -(-(stop - start) // k) * k
-        return shd.put_epoch(mesh, plan.slab(start, stop, pad_to=pad_to))
-
-    def run_epoch(state):
-        total = jnp.zeros((), jnp.float32)
-        loss = None
-        nxt = stage(0)
-        for s in range(splan.n_slabs):
-            cur = nxt
-            if s + 1 < splan.n_slabs:
-                nxt = stage(s + 1)
-            base = s * S
-            staged_len = jax.tree.leaves(cur)[0].shape[0]
-            for j in range(staged_len // k):
-                gstart = base + j * k
-                if gstart >= n_steps:
-                    break
-                hi = min(n_steps - gstart, k)
-                slab = (cur if staged_len == k else
-                        jax.tree.map(lambda a: a[j * k:(j + 1) * k], cur))
-                state, total, loss = superstep(state, total, slab, 0, hi)
-            if s + 1 < splan.n_slabs:
-                jax.device_get(loss)      # slab-boundary fence (train parity)
-        return state, loss
-
-    return run_epoch, state, superstep, splan
+            **_sweep_obs_fields(runner.dispatch_fn, ms, sampler)}
 
 
 def _staging_row(splan, superstep, budget_bytes, n_steps, ms,
@@ -375,15 +296,13 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
     1 in every row. The tracked artifact metric is the streamed/full
     steps/s ratio (the overlap claim: streaming should cost ~nothing)."""
     from tpudist.parallel import build_mesh
+    from tpudist.tune import probe
     cfg = TrainConfig(batch_size=64, lr=1e-3, seed=0,
                       data=DataConfig(n_samples=n_steps * 64),
                       parallel=ParallelConfig(data=-1))
     mesh = build_mesh(cfg.parallel)
     k = 32
-    plan = data.plan_epoch(
-        data.make_synthetic_data(n_steps * 64, cfg.data.n_features,
-                                 cfg.data.seed),
-        batch_size=64, seed=0, epoch=0)
+    plan = _sweep_plan(cfg, n_steps)
     batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
     step_bytes = max(1, plan.bytes_per_step // batch_shards)
     # budget: exactly two k-step slabs + slack — a fraction of the epoch,
@@ -392,13 +311,16 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
     cells = [(None,), (budget,)]
     runners = {}
     for (b,) in cells:
-        run_epoch, state, superstep, splan = _staging_runner(
-            cfg, mesh, k, n_steps, b)
-        state, loss = run_epoch(state)        # trace + compile + warm
+        # tune.probe's epoch harness IS train._superstep_epoch's staging
+        # shape (full-epoch fast path or double-buffered streaming)
+        runner = probe.EpochRunner(cfg, mesh, k, plan, n_steps,
+                                   budget_bytes=b)
+        state = runner.init_state()
+        state, loss = runner.run_epoch(state)  # trace + compile + warm
         jax.device_get(loss)
         # per-MODE sampler, created before this mode's timed epochs:
         # its peak brackets this mode's footprint, not the whole sweep
-        runners[b] = [run_epoch, state, superstep, splan, [],
+        runners[b] = [runner, state, runner.dispatch_fn, runner.splan, [],
                       HbmSampler(period_s=0)]
     # interleave the two modes' timed epochs so host-load drift affects
     # both equally instead of biasing whichever cell ran later
@@ -406,7 +328,7 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
         for (b,) in cells:
             r = runners[b]
             t0 = time.perf_counter()
-            r[1], loss = r[0](r[1])
+            r[1], loss = r[0].run_epoch(r[1])
             jax.device_get(loss)              # fence
             r[4].append((time.perf_counter() - t0) * 1000 / n_steps)
             r[5].sample()
@@ -468,6 +390,64 @@ def run_dispatch_sweep(out_path: str, n_steps: int = 128,
             "rows": rows,
             "speedup_k32_vs_k1": round(
                 by_k[32]["steps_per_sec"] / by_k[1]["steps_per_sec"], 3),
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art))
+    return art
+
+
+def run_tune_sweep(out_path: str, n_steps: int = 128,
+                   repeats: int = 5) -> dict:
+    """The autotuner row: heuristic-pick vs measured-probe steps/s on the
+    CPU dispatch-bound tiny MLP, against the k={1,8,32} dispatch sweep as
+    ground truth. ``--log-every 32`` shapes the legal k space to the full
+    ladder {1..32}, so the search must climb the same curve the sweep
+    measures; the artifact records whether the selected point lands
+    within 10% of the sweep's best (the acceptance band) and that an
+    immediate re-tune is a pure cache hit — zero probe trials."""
+    import tempfile
+
+    from tpudist import tune as tune_lib
+    from tpudist.parallel import build_mesh
+    cfg = TrainConfig(batch_size=64, lr=1e-3, seed=0, log_every=32,
+                      autotune_cache_dir=tempfile.mkdtemp(
+                          prefix="tpudist_tune_"),
+                      data=DataConfig(n_samples=n_steps * 64),
+                      parallel=ParallelConfig(data=-1))
+    mesh = build_mesh(cfg.parallel)
+    sweep = [_dispatch_cell(cfg, mesh, k, n_steps, repeats)
+             for k in (1, 8, 32)]
+    plan = _sweep_plan(cfg, n_steps)
+    first = tune_lib.autotune(cfg, mesh, plan, mode="probe",
+                              n_steps=n_steps, repeats=repeats)
+    rerun = tune_lib.autotune(cfg, mesh, plan, mode="probe",
+                              n_steps=n_steps, repeats=repeats)
+    best_sps = max(r["steps_per_sec"] for r in sweep)
+    sel_sps = first.steps_per_sec or 0.0
+    art = {
+        "metric": "autotuned_vs_heuristic_steps_ratio",
+        "value": round(sel_sps / (first.baseline_steps_per_sec or sel_sps
+                                  or 1.0), 4),
+        "unit": "autotuned steps/s / heuristic-pick steps/s (tiny MLP)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "model": "mlp", "global_batch": cfg.batch_size,
+            "n_steps": n_steps, "log_every": cfg.log_every,
+            "sweep_rows": sweep,
+            "selected": {**first.tuned.as_dict(),
+                         "steps_per_sec": first.steps_per_sec},
+            "heuristic_steps_per_sec": first.baseline_steps_per_sec,
+            "tuning_status": first.status,
+            "trials": first.trials, "pruned": first.pruned,
+            "fingerprint": first.fingerprint,
+            "within_10pct_of_sweep_best": bool(sel_sps >= 0.9 * best_sps),
+            "rerun_source": rerun.source,
+            "rerun_trials": rerun.trials,
+            "rerun_is_pure_cache_hit": bool(
+                rerun.source == "cache" and rerun.trials == 0),
         },
     }
     with open(out_path, "w") as f:
@@ -631,6 +611,12 @@ def main() -> None:
                         "write BENCH_STAGING.json")
     p.add_argument("--staging-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_STAGING.json"))
+    p.add_argument("--tune-sweep", action="store_true",
+                   help="bench the measured-probe autotuner against the "
+                        "dispatch sweep (heuristic-pick vs autotuned "
+                        "steps/s, cache re-hit); write BENCH_TUNE.json")
+    p.add_argument("--tune-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_TUNE.json"))
     p.add_argument("--cell", type=str, default=None,
                    help="internal: run one matrix cell "
                         "(model:seq:head:flash:per_chip:remat)")
@@ -650,6 +636,9 @@ def main() -> None:
         return
     if args.staging_sweep:
         run_staging_sweep(args.staging_out)
+        return
+    if args.tune_sweep:
+        run_tune_sweep(args.tune_out)
         return
     if args.matrix:
         run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)

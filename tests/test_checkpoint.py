@@ -134,6 +134,27 @@ def test_checkpointer_async_roundtrip(tmp_path, cfg, devices8):
         np.asarray(a), np.asarray(b)), state, restored)
 
 
+def test_checkpointer_splits_enqueue_and_drain_timing(tmp_path, cfg,
+                                                      devices8):
+    """Async saves: ``save`` times only the enqueue (snapshot + handoff);
+    the serialisation cost surfaces as blocked time at ``wait``/``close``
+    and accumulates into ``drain_ms`` — the pair is the checkpoint path's
+    honest cost where the old single save_ms under-reported it."""
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = _state(cfg, mesh)
+    ck = checkpoint.Checkpointer(str(tmp_path), use_async=True)
+    assert ck.saves == 0 and ck.drain_ms == 0.0
+    ck.save(state, epoch=0, step_in_epoch=0)
+    assert ck.saves == 1 and ck.last_enqueue_ms > 0
+    assert ck.last_save_ms == ck.last_enqueue_ms   # back-compat alias
+    ck.wait()
+    after_wait = ck.drain_ms
+    assert after_wait >= ck.last_drain_ms >= 0
+    ck.save(state, epoch=1, step_in_epoch=0)
+    ck.close()                                     # close drains too
+    assert ck.saves == 2 and ck.drain_ms >= after_wait
+
+
 def test_restore_full_reads_legacy_epoch_layout(tmp_path, cfg, devices8):
     """A save_dir written by the old epoch-keyed API must stay resumable:
     restore_latest_full falls back to the bare-StandardSave layout and

@@ -1,0 +1,542 @@
+"""The measured-probe autotuner (tpudist.tune): config-resolver edge
+cases, the tuning-cache fingerprint contract (changed mesh/model must
+miss, same config must hit with zero probe trials), the deterministic
+coordinate search's guarantees (plateau commit, infeasible pruning,
+trial budget, never-regress floor), probe trials over the real dispatch
+path, and the train-CLI acceptance parity: a tuned run's per-step losses
+are bitwise-identical to the untuned run."""
+
+import dataclasses
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from tpudist import config as config_lib
+from tpudist import data, tune
+from tpudist.config import DataConfig, ModelConfig, ParallelConfig, TrainConfig
+from tpudist.parallel import build_mesh
+from tpudist.tune import cache as tune_cache
+from tpudist.tune import probe as tune_probe
+from tpudist.tune import search as tune_search
+from tpudist.tune.search import Candidate
+
+
+def _cfg(**kw):
+    base = dict(batch_size=16, epochs=1, lr=1e-2, seed=0,
+                data=DataConfig(n_samples=16 * 12),
+                parallel=ParallelConfig(data=-1))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+# ---------------------------------------- resolver edge cases (config)
+
+
+class TestResolveStepsPerDispatchEdges:
+    def test_explicit_k_not_dividing_ckpt_every_steps_rejected(self):
+        with pytest.raises(ValueError, match="ckpt-every-steps"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=4, ckpt_every_steps=6,
+                     log_every=4))
+
+    def test_auto_honors_both_log_and_ckpt_intervals(self):
+        # divisors of log 4 AND ckpt 6: {1, 2} -> 2
+        assert config_lib.resolve_steps_per_dispatch(
+            _cfg(ckpt_every_steps=6, log_every=4)) == 2
+
+    def test_auto_with_logging_off_caps_at_superstep_cap(self):
+        assert config_lib.resolve_steps_per_dispatch(
+            _cfg(log_every=0)) == config_lib.SUPERSTEP_CAP
+
+    def test_auto_with_log_every_one_is_per_step(self):
+        assert config_lib.resolve_steps_per_dispatch(_cfg(log_every=1)) == 1
+
+    def test_negative_k_rejected(self):
+        with pytest.raises(ValueError, match=">= 1"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=-2))
+
+    def test_k_with_fail_at_rejected(self):
+        with pytest.raises(ValueError, match="fail-at"):
+            config_lib.resolve_steps_per_dispatch(
+                _cfg(steps_per_dispatch=4, fail_at=0, log_every=4))
+
+
+class TestResolveStagingBudgetEdges:
+    def test_zero_env_budget_rejected(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_STAGING_BUDGET_MB", "0")
+        with pytest.raises(ValueError, match="staging-budget-mb"):
+            config_lib.resolve_staging_budget_bytes(_cfg())
+
+    def test_negative_flag_budget_rejected(self):
+        with pytest.raises(ValueError, match="staging-budget-mb"):
+            config_lib.resolve_staging_budget_bytes(
+                _cfg(staging_budget_mb=-1.0))
+
+    def test_auto_without_memory_stats_is_unbounded(self, monkeypatch):
+        # the missing-memory_stats path: no hbm estimate -> no budget
+        monkeypatch.delenv("TPUDIST_STAGING_BUDGET_MB", raising=False)
+        assert config_lib.resolve_staging_budget_bytes(
+            _cfg(), state_bytes=123, hbm_bytes=None) is None
+
+    def test_floor_applies_when_state_headroom_eats_device(self,
+                                                           monkeypatch):
+        monkeypatch.delenv("TPUDIST_STAGING_BUDGET_MB", raising=False)
+        got = config_lib.resolve_staging_budget_bytes(
+            _cfg(), state_bytes=2**30, hbm_bytes=2**30)
+        # 4x state > device: the 5% floor keeps a positive budget
+        assert got == int(2**30 * config_lib.STAGING_FLOOR_FRACTION
+                          * config_lib.STAGING_FREE_FRACTION)
+        assert got > 0
+
+
+class TestResolveAutotune:
+    def test_default_is_off(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_AUTOTUNE", raising=False)
+        assert config_lib.resolve_autotune(_cfg()) == "off"
+
+    def test_env_var_enables(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_AUTOTUNE", "probe")
+        assert config_lib.resolve_autotune(_cfg()) == "probe"
+
+    def test_flag_beats_env(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_AUTOTUNE", "probe")
+        assert config_lib.resolve_autotune(_cfg(autotune="off")) == "off"
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="autotune"):
+            config_lib.resolve_autotune(_cfg(autotune="always"))
+
+    def test_fail_at_and_profiling_force_off(self):
+        assert config_lib.resolve_autotune(
+            _cfg(autotune="probe", fail_at=1)) == "off"
+        assert config_lib.resolve_autotune(
+            _cfg(autotune="probe", profile_dir="/tmp/x")) == "off"
+
+    def test_cache_dir_precedence(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_AUTOTUNE_CACHE_DIR", "/env/dir")
+        assert config_lib.resolve_autotune_cache_dir(
+            _cfg(autotune_cache_dir="/flag/dir")) == "/flag/dir"
+        assert config_lib.resolve_autotune_cache_dir(_cfg()) == "/env/dir"
+        monkeypatch.delenv("TPUDIST_AUTOTUNE_CACHE_DIR")
+        assert config_lib.resolve_autotune_cache_dir(
+            _cfg(save_dir="/sv")) == os.path.join("/sv", "tune")
+
+    def test_trials_resolution(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_AUTOTUNE_TRIALS", raising=False)
+        assert (config_lib.resolve_autotune_trials(_cfg())
+                == config_lib.AUTOTUNE_DEFAULT_TRIALS)
+        monkeypatch.setenv("TPUDIST_AUTOTUNE_TRIALS", "3")
+        assert config_lib.resolve_autotune_trials(_cfg()) == 3
+        assert config_lib.resolve_autotune_trials(
+            _cfg(autotune_trials=7)) == 7
+        with pytest.raises(ValueError, match="autotune-trials"):
+            config_lib.resolve_autotune_trials(_cfg(autotune_trials=-1))
+
+    def test_cli_flags_parse(self):
+        cfg = config_lib.parse_args(
+            ["--autotune", "probe", "--autotune-cache-dir", "/x",
+             "--autotune-trials", "5"])
+        assert cfg.autotune == "probe"
+        assert cfg.autotune_cache_dir == "/x"
+        assert cfg.autotune_trials == 5
+
+
+# --------------------------------------------- fingerprint and cache
+
+
+class TestTuningCache:
+    def _mesh(self, devices8, **par):
+        return build_mesh(ParallelConfig(**par), devices=devices8)
+
+    def test_same_config_same_fingerprint(self, devices8):
+        mesh = self._mesh(devices8)
+        assert (tune_cache.fingerprint(_cfg(), mesh)
+                == tune_cache.fingerprint(_cfg(), mesh))
+
+    def test_changed_mesh_shape_misses(self, devices8):
+        fp1 = tune_cache.fingerprint(_cfg(), self._mesh(devices8))
+        fp2 = tune_cache.fingerprint(_cfg(), self._mesh(devices8, data=4,
+                                                        fsdp=2))
+        assert fp1 != fp2
+
+    def test_changed_model_config_misses(self, devices8):
+        mesh = self._mesh(devices8)
+        fp1 = tune_cache.fingerprint(_cfg(), mesh)
+        fp2 = tune_cache.fingerprint(
+            _cfg(model=ModelConfig(name="mlp", hidden=128)), mesh)
+        assert fp1 != fp2
+
+    def test_changed_intervals_miss(self, devices8):
+        # log/ckpt intervals bound the legal k space -> part of the key
+        mesh = self._mesh(devices8)
+        assert (tune_cache.fingerprint(_cfg(log_every=4), mesh)
+                != tune_cache.fingerprint(_cfg(log_every=8), mesh))
+
+    def test_store_load_roundtrip(self, tmp_path, devices8):
+        mesh = self._mesh(devices8)
+        fp = tune_cache.fingerprint(_cfg(), mesh)
+        tuned = {"k": 8, "staging_budget_mb": 1.5, "remat": False,
+                 "grad_accum_steps": 1}
+        assert tune_cache.store(str(tmp_path), fp,
+                                {"tuned": tuned, "steps_per_sec": 100.0})
+        rec = tune_cache.load(str(tmp_path), fp)
+        assert rec["tuned"] == tuned and rec["fingerprint"] == fp
+        # wrong fingerprint -> miss, not error
+        assert tune_cache.load(str(tmp_path), "0" * 16) is None
+
+    def test_corrupt_or_invalid_file_is_a_miss(self, tmp_path, devices8):
+        fp = tune_cache.fingerprint(_cfg(), self._mesh(devices8))
+        path = tune_cache.cache_path(str(tmp_path), fp)
+        os.makedirs(str(tmp_path), exist_ok=True)
+        with open(path, "w") as f:
+            f.write("{not json")
+        assert tune_cache.load(str(tmp_path), fp) is None
+        with open(path, "w") as f:
+            json.dump({"schema": tune_cache.SCHEMA, "fingerprint": fp,
+                       "tuned": {"k": 0, "staging_budget_mb": None,
+                                 "remat": False, "grad_accum_steps": 1}},
+                      f)   # k=0 is insane -> miss
+        assert tune_cache.load(str(tmp_path), fp) is None
+        for bad_budget in ("1.5", -2.0, 0, True):
+            with open(path, "w") as f:
+                json.dump({"schema": tune_cache.SCHEMA, "fingerprint": fp,
+                           "tuned": {"k": 4,
+                                     "staging_budget_mb": bad_budget,
+                                     "remat": False,
+                                     "grad_accum_steps": 1}}, f)
+            # an insane budget must read as a miss here, not crash the
+            # run later in resolve_staging_budget_bytes
+            assert tune_cache.load(str(tmp_path), fp) is None, bad_budget
+
+    def test_store_is_atomic_no_tmp_left(self, tmp_path, devices8):
+        fp = tune_cache.fingerprint(_cfg(), self._mesh(devices8))
+        tune_cache.store(str(tmp_path), fp, {"tuned": {
+            "k": 1, "staging_budget_mb": None, "remat": False,
+            "grad_accum_steps": 1}})
+        names = os.listdir(str(tmp_path))
+        assert names == [f"tune-{fp}.json"]
+
+
+# --------------------------------------------------- coordinate search
+
+
+def _res(sps, feasible=True, counted=True):
+    return tune_probe.ProbeResult(sps, 1000.0 / sps if sps else float("inf"),
+                                  8, 1, feasible=feasible, counted=counted)
+
+
+class TestCoordinateSearch:
+    START = Candidate(k=8, staging_budget_mb=None, remat=False,
+                      grad_accum_steps=1)
+    AXES = {"k": [1, 2, 4, 8, 16, 32], "staging_budget_mb": [None],
+            "remat": [False], "grad_accum_steps": [1]}
+
+    def test_commits_the_plateau_not_past_it(self):
+        # 16 and 32 within 2% of each other: plateau preference commits
+        # the SMALLER k at indistinguishable speed
+        sps = {1: 100, 2: 180, 4: 300, 8: 500, 16: 995, 32: 1000}
+        out = tune_search.coordinate_search(
+            self.START, self.AXES, lambda c: _res(sps[c.k]),
+            trial_budget=16)
+        assert out.best.k == 16
+        assert out.best_sps >= out.baseline_sps
+
+    def test_trial_budget_bounds_measurements(self):
+        calls = []
+
+        def measure(c):
+            calls.append(c)
+            return _res(100.0 * c.k)
+        out = tune_search.coordinate_search(self.START, self.AXES, measure,
+                                            trial_budget=3)
+        assert len(calls) == 3 and out.trials == 3
+        assert out.exhausted
+
+    def test_memoised_results_do_not_consume_budget(self):
+        def measure(c):
+            return _res(100.0 * c.k, counted=(c.k != 1))
+        out = tune_search.coordinate_search(self.START, self.AXES, measure,
+                                            trial_budget=16)
+        # k=1 was measured but uncounted (memo hit)
+        assert out.trials < sum(len(v) for v in self.AXES.values())
+
+    def test_early_stop_past_the_plateau(self):
+        # the curve turns down decisively after 8: 16/32 never probed past
+        calls = []
+        sps = {1: 100, 2: 400, 4: 800, 8: 500, 16: 60, 32: 55}
+
+        def measure(c):
+            calls.append(c.k)
+            return _res(sps[c.k])
+        out = tune_search.coordinate_search(self.START, self.AXES, measure,
+                                            trial_budget=16)
+        assert out.best.k == 4
+        assert 32 not in calls
+
+    def test_infeasible_point_stops_the_ascent(self):
+        calls = []
+
+        def measure(c):
+            calls.append(c.k)
+            if c.k >= 16:
+                return _res(0.0, feasible=False)
+            return _res(100.0 * c.k)
+        out = tune_search.coordinate_search(self.START, self.AXES, measure,
+                                            trial_budget=16)
+        assert out.best.k == 8
+        assert 32 not in calls          # 16 infeasible -> 32 not probed
+        assert out.pruned == 1
+
+    def test_never_regresses_the_seed(self):
+        out = tune_search.coordinate_search(
+            self.START, self.AXES,
+            lambda c: _res(500.0 if c == self.START else 400.0),
+            trial_budget=16)
+        assert out.best == self.START and out.best_sps == 500.0
+
+    def test_math_knob_needs_a_clear_win(self):
+        axes = {"k": [8], "staging_budget_mb": [None],
+                "remat": [False, True], "grad_accum_steps": [1]}
+        # remat "wins" by under the improvement gate -> not committed
+        out = tune_search.coordinate_search(
+            self.START, axes,
+            lambda c: _res(505.0 if c.remat else 500.0), trial_budget=8)
+        assert out.best.remat is False
+        # a clear win IS committed
+        out = tune_search.coordinate_search(
+            self.START, axes,
+            lambda c: _res(600.0 if c.remat else 500.0), trial_budget=8)
+        assert out.best.remat is True
+
+    def test_math_knob_win_must_clear_the_noise_floor(self):
+        """A 'win' inside the trials' own repeat spread is jitter, not
+        signal: on a loaded host (spread ~20%) a 10% grad-accum 'win'
+        must NOT displace the bitwise-parity-preserving seed value."""
+        axes = {"k": [8], "staging_budget_mb": [None],
+                "remat": [False], "grad_accum_steps": [1, 2]}
+
+        def noisy(c):
+            sps = 550.0 if c.grad_accum_steps == 2 else 500.0
+            return tune_probe.ProbeResult(sps, 1000.0 / sps, 8, 3,
+                                          spread=0.2)
+        out = tune_search.coordinate_search(self.START, axes, noisy,
+                                            trial_budget=8)
+        assert out.best.grad_accum_steps == 1
+        # the same 10% win with a quiet 1% noise floor IS committed
+        out = tune_search.coordinate_search(
+            self.START, axes,
+            lambda c: tune_probe.ProbeResult(
+                550.0 if c.grad_accum_steps == 2 else 500.0, 2.0, 8, 3,
+                spread=0.01),
+            trial_budget=8)
+        assert out.best.grad_accum_steps == 2
+
+    def test_k_candidates_respect_constraints(self):
+        ks = tune_search.k_candidates(_cfg(log_every=4, ckpt_every_steps=6))
+        assert ks == [1, 2]
+        ks = tune_search.k_candidates(_cfg(log_every=32))
+        assert ks == [1, 2, 4, 8, 16, 32]
+        assert tune_search.k_candidates(_cfg(fail_at=0)) == [1]
+        ks = tune_search.k_candidates(_cfg(log_every=100))
+        assert ks[-1] == 25 and 1 in ks     # largest legal divisor kept
+
+    def test_build_space_filters_grad_accum_by_batch(self):
+        axes = tune_search.build_space(_cfg(batch_size=16), batch_ways=8)
+        assert axes["grad_accum_steps"] == [1, 2]
+        axes = tune_search.build_space(_cfg(), batch_ways=1)
+        assert axes["remat"] == [False]     # mlp has no layers to remat
+
+
+# ----------------------------------------------------- probe (on CPU)
+
+
+class TestProbe:
+    def _setup(self, n_steps=12):
+        cfg = _cfg(log_every=4)
+        mesh = build_mesh(cfg.parallel)
+        plan = data.plan_epoch(
+            data.make_synthetic_data(cfg.data.n_samples,
+                                     cfg.data.n_features, cfg.data.seed),
+            batch_size=cfg.batch_size, seed=cfg.seed, epoch=0)
+        return cfg, mesh, plan
+
+    def test_probe_measures_the_real_superstep(self):
+        cfg, mesh, plan = self._setup()
+        cand = Candidate(k=4, staging_budget_mb=None, remat=False,
+                         grad_accum_steps=1)
+        res = tune_probe.probe_candidate(cfg, mesh, cand, plan,
+                                         n_steps=8, repeats=2)
+        assert res.feasible and res.steps_per_sec > 0
+        assert res.n_steps == 8 and res.error is None
+        assert res.key is not None
+
+    def test_infeasible_slab_plan_is_pruned_not_raised(self):
+        cfg, mesh, plan = self._setup()
+        # a budget that cannot double-buffer one k-slab: plan_slabs
+        # raises; the probe converts it to a pruned result
+        cand = Candidate(k=4, staging_budget_mb=1e-6, remat=False,
+                         grad_accum_steps=1)
+        res = tune_probe.probe_candidate(cfg, mesh, cand, plan,
+                                         n_steps=8, repeats=1)
+        assert not res.feasible
+        assert "staging budget" in (res.error or "")
+
+    def test_candidate_key_dedupes_equal_programs(self):
+        cfg, mesh, plan = self._setup()
+        huge_a = Candidate(k=4, staging_budget_mb=1000.0, remat=False,
+                           grad_accum_steps=1)
+        huge_b = Candidate(k=4, staging_budget_mb=2000.0, remat=False,
+                           grad_accum_steps=1)
+        ka = tune_probe.candidate_key(cfg, mesh, huge_a, plan, 12)
+        kb = tune_probe.candidate_key(cfg, mesh, huge_b, plan, 12)
+        assert ka == kb                 # both: full-epoch fast path
+        # a budget that holds two 4-step slabs but not the 12-step epoch
+        # STREAMS: a genuinely different program, different key
+        tiny = Candidate(k=4, staging_budget_mb=0.0015, remat=False,
+                         grad_accum_steps=1)
+        assert tune_probe.candidate_key(cfg, mesh, tiny, plan, 12) != ka
+
+    def test_runner_k1_matches_per_step_path(self):
+        cfg, mesh, plan = self._setup()
+        runner = tune_probe.EpochRunner(cfg, mesh, 1, plan, 6)
+        state, times, compile_s = tune_probe.time_runner(runner, repeats=1)
+        assert len(times) == 1 and times[0] > 0 and compile_s > 0
+        assert int(state.step) == 12    # warm epoch + timed epoch
+
+
+# ---------------------------------------------- autotune end-to-end
+
+
+class TestAutotune:
+    def _setup(self, tmp_path, **kw):
+        cfg = _cfg(log_every=4, autotune_cache_dir=str(tmp_path / "tc"),
+                   **kw)
+        mesh = build_mesh(cfg.parallel)
+        plan = data.plan_epoch(
+            data.make_synthetic_data(cfg.data.n_samples,
+                                     cfg.data.n_features, cfg.data.seed),
+            batch_size=cfg.batch_size, seed=cfg.seed, epoch=0)
+        return cfg, mesh, plan
+
+    def test_probe_then_pure_cache_hit(self, tmp_path):
+        cfg, mesh, plan = self._setup(tmp_path, autotune_trials=4)
+        out1 = tune.autotune(cfg, mesh, plan, mode="probe", n_steps=8,
+                             repeats=1)
+        assert out1.source == "probe" and out1.trials > 0
+        assert out1.status == "success"
+        assert out1.cfg.steps_per_dispatch == out1.tuned.k > 0
+        out2 = tune.autotune(cfg, mesh, plan, mode="probe", n_steps=8,
+                             repeats=1)
+        assert out2.source == "cache" and out2.trials == 0
+        assert out2.tuned == out1.tuned
+        assert out2.status == "success"
+
+    def test_cache_only_miss_runs_heuristics_ungated(self, tmp_path):
+        cfg, mesh, plan = self._setup(tmp_path)
+        out = tune.autotune(cfg, mesh, plan, mode="cache-only", n_steps=8)
+        assert out.source == "heuristic" and out.trials == 0
+        assert out.status == "ungateable"
+        assert out.cfg is cfg           # untouched: pure heuristic run
+
+    def test_cache_only_after_probe_hits(self, tmp_path):
+        cfg, mesh, plan = self._setup(tmp_path, autotune_trials=3)
+        tune.autotune(cfg, mesh, plan, mode="probe", n_steps=8, repeats=1)
+        out = tune.autotune(cfg, mesh, plan, mode="cache-only", n_steps=8)
+        assert out.source == "cache" and out.trials == 0
+
+    def test_changed_workload_reprobes(self, tmp_path):
+        cfg, mesh, plan = self._setup(tmp_path, autotune_trials=3)
+        out1 = tune.autotune(cfg, mesh, plan, mode="probe", n_steps=8,
+                             repeats=1)
+        cfg2 = dataclasses.replace(cfg, batch_size=8)
+        plan2 = data.plan_epoch(
+            data.make_synthetic_data(cfg2.data.n_samples,
+                                     cfg2.data.n_features,
+                                     cfg2.data.seed),
+            batch_size=cfg2.batch_size, seed=cfg2.seed, epoch=0)
+        out2 = tune.autotune(cfg2, mesh, plan2, mode="probe", n_steps=8,
+                             repeats=1)
+        assert out2.fingerprint != out1.fingerprint
+        assert out2.source == "probe" and out2.trials > 0
+
+    def test_kind_tune_record_logged(self, tmp_path):
+        from tpudist.metrics import MetricsLogger
+        cfg, mesh, plan = self._setup(tmp_path, autotune_trials=3)
+        m = MetricsLogger(path=None)
+        tune.autotune(cfg, mesh, plan, mode="probe", metrics=m, n_steps=8,
+                      repeats=1)
+        recs = [r for r in m.history if r["kind"] == "tune"]
+        assert len(recs) == 1
+        r = recs[0]
+        assert r["source"] == "probe" and r["trials"] > 0
+        assert r["steps_per_dispatch"] >= 1 and r["fingerprint"]
+        m.close()
+
+
+# ------------------------------------------- train-CLI acceptance
+
+
+def _cli_run(tmp_path, capsys, name, extra):
+    from tpudist import train as train_mod
+    save = tmp_path / name
+    rc = train_mod.main(["--epochs", "2", "--train-batch-size", "64",
+                         "--n-samples", "640", "--log-every", "2",
+                         "--save-dir", str(save)] + extra)
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    with open(save / "metrics.jsonl") as f:
+        return out, [json.loads(ln) for ln in f]
+
+
+def test_cli_tuned_run_bitwise_matches_untuned(tmp_path, capsys,
+                                               monkeypatch):
+    """The acceptance criterion: per-step losses of the autotuned run are
+    bitwise-identical to the untuned run, and an immediate second run
+    resolves entirely from the tuning cache with zero probe trials."""
+    monkeypatch.delenv("TPUDIST_AUTOTUNE", raising=False)
+    cache = str(tmp_path / "cache")
+    out_ref, ref = _cli_run(tmp_path, capsys, "ref", [])
+    out_tuned, tuned = _cli_run(
+        tmp_path, capsys, "tuned",
+        ["--autotune", "probe", "--autotune-trials", "4",
+         "--autotune-cache-dir", cache])
+    assert "tuning success" in out_tuned
+
+    def steps(recs):
+        return [(r["epoch"], r["step"], r["loss"]) for r in recs
+                if r["kind"] == "step"]
+    assert steps(tuned) == steps(ref)   # bitwise: same floats via JSON
+    assert [ln for ln in out_ref.splitlines() if "Avg loss" in ln] == \
+        [ln for ln in out_tuned.splitlines() if "Avg loss" in ln]
+    t1 = [r for r in tuned if r["kind"] == "tune"][0]
+    assert t1["source"] == "probe" and t1["trials"] > 0
+    timing = [r for r in tuned if r["kind"] == "timing"][0]
+    assert timing["tuning_status"] == "success"
+    ref_timing = [r for r in ref if r["kind"] == "timing"][0]
+    assert ref_timing["tuning_status"] == "ungateable"
+
+    # second tuned run: pure cache hit, zero probes, same commitment
+    out2, tuned2 = _cli_run(
+        tmp_path, capsys, "tuned2",
+        ["--autotune", "probe", "--autotune-trials", "4",
+         "--autotune-cache-dir", cache])
+    t2 = [r for r in tuned2 if r["kind"] == "tune"][0]
+    assert t2["source"] == "cache" and t2["trials"] == 0
+    assert t2["steps_per_dispatch"] == t1["steps_per_dispatch"]
+    assert steps(tuned2) == steps(ref)
+
+
+def test_cli_ckpt_records_enqueue_and_drain(tmp_path, capsys):
+    """Satellite: under async orbax the per-save record carries the
+    ENQUEUE time and the run-end record the real drain cost."""
+    _, recs = _cli_run(tmp_path, capsys, "ck", [])
+    saves = [r for r in recs if r["kind"] == "ckpt"]
+    assert saves and all("enqueue_ms" in r for r in saves)
+    assert all("save_ms" not in r for r in saves)
+    drains = [r for r in recs if r["kind"] == "ckpt_drain"]
+    assert len(drains) == 1
+    assert drains[0]["drain_ms"] >= 0 and drains[0]["saves"] == len(saves)

@@ -119,6 +119,14 @@ class TrainConfig:
     # flightrec.worker<i> land. None = $TPUDIST_HEARTBEAT_DIR, else save_dir
     hbm_sample_s: Optional[float] = None  # HBM watermark sampler period
     # (obs.hbm). None = $TPUDIST_HBM_SAMPLE_S, else 2.0; 0 disables
+    autotune: Optional[str] = None  # off | probe | cache-only
+    # (tpudist.tune): measure the dispatch/staging/remat operating point
+    # with short on-device trials before the timed run, or reuse a
+    # cached measurement. None = $TPUDIST_AUTOTUNE, else off.
+    autotune_cache_dir: Optional[str] = None  # tuning-cache directory.
+    # None = $TPUDIST_AUTOTUNE_CACHE_DIR, else <save_dir>/tune
+    autotune_trials: int = 0      # probe-trial budget; 0 = auto
+    # ($TPUDIST_AUTOTUNE_TRIALS, else 12)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -226,6 +234,54 @@ def resolve_staging_budget_bytes(cfg: TrainConfig, *, state_bytes: int = 0,
     free = max(hbm_bytes - STAGING_STATE_HEADROOM * state_bytes,
                hbm_bytes * STAGING_FLOOR_FRACTION)
     return int(free * STAGING_FREE_FRACTION)
+
+
+# Autotune (tpudist.tune): the measured-probe search that replaces the
+# two resolve_* heuristics above with a measurement when enabled. The
+# heuristics stay as the search's START point and its never-regress
+# floor.
+AUTOTUNE_MODES = ("off", "probe", "cache-only")
+AUTOTUNE_DEFAULT_TRIALS = 12
+
+
+def resolve_autotune(cfg: TrainConfig) -> str:
+    """Resolve ``--autotune`` / ``TPUDIST_AUTOTUNE`` to a concrete mode.
+
+    ``probe`` measures on a cache miss; ``cache-only`` reuses a prior
+    measurement but never probes (pod launches where N workers probing
+    at startup is unwanted). Fault injection and profiling force
+    ``off``: both are defined in per-step-dispatch terms, so every knob
+    the tuner searches is already pinned.
+    """
+    mode = cfg.autotune
+    if mode is None:
+        mode = os.environ.get("TPUDIST_AUTOTUNE") or "off"
+    if mode not in AUTOTUNE_MODES:
+        raise ValueError(
+            f"--autotune must be one of {AUTOTUNE_MODES}, got {mode!r}")
+    if mode != "off" and (cfg.fail_at is not None or cfg.profile_dir):
+        return "off"
+    return mode
+
+
+def resolve_autotune_cache_dir(cfg: TrainConfig) -> str:
+    """Precedence: flag > ``TPUDIST_AUTOTUNE_CACHE_DIR`` > a ``tune/``
+    subdir of ``save_dir`` (next to metrics.jsonl — one directory to
+    persist across runs, same shape as the heartbeat default)."""
+    return (cfg.autotune_cache_dir
+            or os.environ.get("TPUDIST_AUTOTUNE_CACHE_DIR")
+            or os.path.join(cfg.save_dir, "tune"))
+
+
+def resolve_autotune_trials(cfg: TrainConfig) -> int:
+    """Probe-trial budget: flag > ``TPUDIST_AUTOTUNE_TRIALS`` > 12."""
+    if cfg.autotune_trials < 0:
+        raise ValueError(
+            f"--autotune-trials must be >= 0, got {cfg.autotune_trials}")
+    if cfg.autotune_trials:
+        return cfg.autotune_trials
+    env = _env_float("TPUDIST_AUTOTUNE_TRIALS")
+    return int(env) if env and env > 0 else AUTOTUNE_DEFAULT_TRIALS
 
 
 # Flight-recorder defaults: the stall window must comfortably exceed any
@@ -404,6 +460,22 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                         "high-water mark lands in the kind=timing record "
                         "(default: $TPUDIST_HBM_SAMPLE_S, else 2.0; "
                         "0 disables)")
+    p.add_argument("--autotune", type=str, default=None,
+                   choices=list(AUTOTUNE_MODES),
+                   help="measured-probe autotuning of the dispatch/"
+                        "staging/remat operating point (tpudist.tune): "
+                        "probe = short on-device trials before the timed "
+                        "run (cached by workload fingerprint; the second "
+                        "run costs zero probes), cache-only = reuse a "
+                        "prior measurement but never probe (default: "
+                        "$TPUDIST_AUTOTUNE, else off)")
+    p.add_argument("--autotune-cache-dir", type=str, default=None,
+                   help="tuning-cache directory (default: "
+                        "$TPUDIST_AUTOTUNE_CACHE_DIR, else "
+                        "<save-dir>/tune)")
+    p.add_argument("--autotune-trials", type=int, default=0,
+                   help="probe-trial budget for the autotune search "
+                        "(0 = $TPUDIST_AUTOTUNE_TRIALS, else 12)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write jax.profiler traces (tensorboard format) "
                         "here; the reference had no profiling at all "
@@ -437,6 +509,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         stall_timeout_s=args.stall_timeout_s,
         heartbeat_dir=args.heartbeat_dir,
         hbm_sample_s=args.hbm_sample_s,
+        autotune=args.autotune,
+        autotune_cache_dir=args.autotune_cache_dir,
+        autotune_trials=args.autotune_trials,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

@@ -326,6 +326,67 @@ def check_staging_stream():
           flush=True)
 
 
+def check_autotune():
+    """The autotune search contract on a SCRIPTED probe harness (fake
+    timers — no device work, so the drill runs identically on every
+    backend): (a) an HBM-infeasible point is PRUNED — the search routes
+    around it and still commits the best feasible point, instead of
+    crashing or committing into an OOM; (b) when every explored point
+    measures slower than the seed heuristic, the commit IS the seed
+    heuristic — the tuner can only ever match or beat the static
+    resolve_* guess it replaced."""
+    from tpudist.tune import probe, search
+
+    start = search.Candidate(k=8, staging_budget_mb=None, remat=False,
+                             grad_accum_steps=1)
+    axes = {"k": [1, 2, 4, 8, 16, 32], "staging_budget_mb": [None],
+            "remat": [False], "grad_accum_steps": [1]}
+
+    def scripted(sps_by_k, infeasible_ks=()):
+        calls = []
+
+        def measure(cand):
+            calls.append(cand)
+            if cand.k in infeasible_ks:
+                return probe.ProbeResult(
+                    0.0, float("inf"), 8, 1, feasible=False,
+                    error="RESOURCE_EXHAUSTED (scripted hbm wall)")
+            ms = 1000.0 / sps_by_k[cand.k]
+            return probe.ProbeResult(sps_by_k[cand.k], ms, 8, 1)
+        return measure, calls
+
+    # (a) the fastest point on the curve (k=32) is over the fake HBM
+    # wall: prune it, commit the best feasible point (k=16)
+    measure, calls = scripted({1: 100.0, 2: 180.0, 4: 300.0, 8: 500.0,
+                               16: 640.0}, infeasible_ks=(32,))
+    out = search.coordinate_search(start, axes, measure, trial_budget=16)
+    assert out.best.k == 16, f"expected k=16 commit, got {out.best}"
+    assert out.pruned == 1, f"infeasible point not pruned: {out.pruned}"
+    assert out.best_sps >= out.baseline_sps
+    assert out.trials <= 16
+
+    # (b) every alternative regresses the seed heuristic: the commit
+    # must be the seed, exactly
+    measure, calls = scripted({k: (500.0 if k == 8 else 200.0)
+                               for k in (1, 2, 4, 8, 16, 32)})
+    out2 = search.coordinate_search(start, axes, measure, trial_budget=16)
+    assert out2.best == start, f"regressing commit: {out2.best}"
+    assert out2.best_sps == out2.baseline_sps == 500.0
+
+    # (c) a measure() that RAISES is a pruned point, not a dead search
+    def exploding(cand):
+        if cand.k == 32:
+            raise RuntimeError("scripted probe crash")
+        sps = {1: 100.0, 2: 180.0, 4: 300.0, 8: 500.0, 16: 640.0}[cand.k]
+        return probe.ProbeResult(sps, 1000.0 / sps, 8, 1)
+    out3 = search.coordinate_search(start, axes, exploding,
+                                    trial_budget=16)
+    assert out3.best.k == 16 and out3.pruned == 1, out3
+    print(f"  autotune drill: hbm-wall commit k={out.best.k} "
+          f"({out.trials} trials, {out.pruned} pruned), "
+          f"regression floor held at k={out2.best.k}", flush=True)
+
+
 def check_flight_recorder():
     """The flight-recorder pipeline end-to-end with a DELIBERATELY
     wedged step: progress beacons flow while steps advance, then the
@@ -405,6 +466,7 @@ def check_moe_smoke():
 
 
 CHECKS = [
+    check_autotune,
     check_fused_xent,
     check_fused_xent_bench_geometry,
     check_flash_attention,

@@ -103,6 +103,32 @@ def run(cfg: TrainConfig) -> float:
     # --- model + engine (DeepSpeed-engine equivalent) ---
     state = engine_lib.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
 
+    metrics = MetricsLogger(
+        path=os.path.join(cfg.save_dir, "metrics.jsonl")
+        if ctx.is_coordinator else None)
+
+    # measured-probe autotune (tpudist.tune): replace the static
+    # resolve_* guesses below with short on-device trials of the real
+    # superstep (or a cached prior measurement) BEFORE the timed run —
+    # the committed knobs land in cfg as explicit settings, so the rest
+    # of the loop is oblivious to how they were chosen
+    autotune_mode = config_lib.resolve_autotune(cfg)
+    tuning_status = verdict_lib.tuning_status(autotune_mode)
+    if autotune_mode != "off":
+        from tpudist import tune as tune_lib
+        outcome = tune_lib.autotune(
+            cfg, mesh, epoch_plan(0), mode=autotune_mode, metrics=metrics,
+            is_coordinator=ctx.is_coordinator,
+            state_bytes=engine_lib.state_bytes_per_device(state),
+            hbm_bytes=engine_lib._device_hbm_bytes())
+        cfg = outcome.cfg
+        tuning_status = outcome.status
+        t = outcome.tuned
+        log0(f"tpudist: tuning {outcome.status} ({outcome.source}): "
+             f"k={t.k}, staging {t.staging_budget_mb} MB, "
+             f"remat={t.remat}, grad_accum={t.grad_accum_steps} "
+             f"({outcome.trials} probe trials, {outcome.pruned} pruned)")
+
     # superstep dispatch: k compiled steps per host dispatch (the paper's
     # workload is dispatch-bound by construction — per-step Python
     # dispatch hides the fabric performance the test is measuring);
@@ -146,9 +172,6 @@ def run(cfg: TrainConfig) -> float:
             log0(f"Resumed at epoch {start_epoch}, step "
                  f"{start_step_in_epoch} (global step {int(state.step)}).")
 
-    metrics = MetricsLogger(
-        path=os.path.join(cfg.save_dir, "metrics.jsonl")
-        if ctx.is_coordinator else None)
     timer = StepTimer()
     last_avg = float("nan")
 
@@ -179,6 +202,11 @@ def run(cfg: TrainConfig) -> float:
     finally:
         observer.note_progress(phase="shutdown")
         ckpt.close()   # drain outstanding async writes before exiting
+        # the async-checkpoint cost the per-save enqueue_ms cannot see:
+        # total time this run spent BLOCKED on serialisation drains
+        # (its own kind: every kind=ckpt record stays a per-save record)
+        metrics.log(kind="ckpt_drain", drain_ms=round(ckpt.drain_ms, 1),
+                    saves=ckpt.saves)
         observer.close()  # stop watchdog/sampler threads, final beacon
         metrics.close()  # flush the buffered JSONL stream even on failure
 
@@ -221,7 +249,8 @@ def run(cfg: TrainConfig) -> float:
                 if obs_fields.get("hbm_peak_fraction") else ""))
     metrics.log(kind="timing", steps_per_dispatch=k, **timer.split(),
                 **staging.split(), staging_overlap_fraction=overlap,
-                staging_status=staging_verdict, **obs_fields)
+                staging_status=staging_verdict,
+                tuning_status=tuning_status, **obs_fields)
     log0("Training completed.")  # parity banner (train.py:128)
     metrics.close()
     return last_avg
@@ -357,8 +386,8 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                 pending = 0
                 ckpt.save(state, epoch=epoch, step_in_epoch=end)
                 metrics.log(kind="ckpt", epoch=epoch, step=int(state.step),
-                            step_in_epoch=end, save_ms=round(
-                                ckpt.last_save_ms, 1))
+                            step_in_epoch=end, enqueue_ms=round(
+                                ckpt.last_enqueue_ms, 1))
                 # already fenced and doing file I/O: flushing here bounds
                 # a hard crash's metrics loss to one ckpt interval
                 metrics.flush()
@@ -448,7 +477,7 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 ckpt.save(state, epoch=epoch, step_in_epoch=i + 1)
                 metrics.log(kind="ckpt", epoch=epoch, step=int(state.step),
                             step_in_epoch=i + 1,
-                            save_ms=round(ckpt.last_save_ms, 1))
+                            enqueue_ms=round(ckpt.last_enqueue_ms, 1))
                 # already fenced and doing file I/O: flushing here bounds
                 # a hard crash's metrics loss to one ckpt interval
                 metrics.flush()
@@ -501,7 +530,7 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
         observer.note_progress(phase="ckpt", epoch=epoch)
     ckpt.save(state, epoch=epoch + 1, step_in_epoch=0)
     metrics.log(kind="ckpt", epoch=epoch, step=int(state.step),
-                step_in_epoch=0, save_ms=round(ckpt.last_save_ms, 1))
+                step_in_epoch=0, enqueue_ms=round(ckpt.last_enqueue_ms, 1))
     # the buffered JSONL stream hits the filesystem here, off the step
     # path (metrics.MetricsLogger: writes must never land in a timed
     # fence window) — and before the fault-injection raise below

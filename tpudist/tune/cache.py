@@ -1,0 +1,104 @@
+"""Persisted tuning cache: measure once, reuse every run.
+
+A tuned operating point is only valid for the exact situation it was
+measured in, so cache entries are keyed by a FINGERPRINT of everything
+that moves the curve: the model config, global batch, dtypes, the
+log/ckpt intervals (they bound the legal k space), the mesh shape,
+device kind and counts, and the jax + tpudist versions. Any of those
+changing is a different workload — the lookup MUST miss and re-probe,
+exactly like the XLA compilation cache misses on a changed program.
+
+One JSON file per fingerprint under the cache dir, written ATOMICALLY
+(tmp + rename) and by the COORDINATOR only — workers on a shared
+filesystem must never race partial writes; readers treat any unreadable
+or mismatched file as a miss, never an error. A cache hit costs zero
+probe trials.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+SCHEMA = 1
+
+
+def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
+    """Hex fingerprint of the tuning situation (see module docstring)."""
+    import jax
+
+    from tpudist.version import __version__
+    if device_kind is None:
+        try:
+            device_kind = jax.devices()[0].device_kind
+        except Exception:
+            device_kind = "unknown"
+    payload = {
+        "schema": SCHEMA,
+        "model": dataclasses.asdict(cfg.model),
+        "batch_size": cfg.batch_size,
+        "dtype": cfg.dtype,
+        "adam_nu_dtype": cfg.adam_nu_dtype,
+        "log_every": cfg.log_every,
+        "ckpt_every_steps": cfg.ckpt_every_steps,
+        "mesh": dict(zip(mesh.axis_names,
+                         (int(s) for s in mesh.devices.shape))),
+        "n_devices": jax.device_count(),
+        "n_processes": jax.process_count(),
+        "device_kind": device_kind,
+        "jax": jax.__version__,
+        "tpudist": __version__,
+    }
+    blob = json.dumps(payload, sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+def cache_path(cache_dir: str, fp: str) -> str:
+    return os.path.join(cache_dir, f"tune-{fp}.json")
+
+
+def load(cache_dir: str, fp: str) -> Optional[Dict[str, Any]]:
+    """The cached record for ``fp``, or None on miss — a corrupt,
+    partial, or wrong-schema file reads as a miss (re-probe), never as
+    an error (a stale cache must not fail a run)."""
+    try:
+        with open(cache_path(cache_dir, fp)) as f:
+            rec = json.load(f)
+        if rec.get("schema") != SCHEMA or rec.get("fingerprint") != fp:
+            return None
+        tuned = rec["tuned"]
+        # the four knobs must all be present and sane — an insane value
+        # (wrong type, non-positive) is a MISS here, not a crash later
+        # in resolve_staging_budget_bytes
+        if int(tuned["k"]) < 1 or int(tuned["grad_accum_steps"]) < 1:
+            return None
+        bool(tuned["remat"])
+        budget = tuned["staging_budget_mb"]
+        if budget is not None and (isinstance(budget, bool)
+                                   or not isinstance(budget, (int, float))
+                                   or budget <= 0):
+            return None
+        return rec
+    except (OSError, ValueError, KeyError, TypeError):
+        return None
+
+
+def store(cache_dir: str, fp: str, record: Dict[str, Any]) -> bool:
+    """Atomically persist ``record`` (coordinator only — callers gate).
+    Best-effort: a read-only cache dir degrades to un-cached runs, not a
+    failed one."""
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        path = cache_path(cache_dir, fp)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({**record, "schema": SCHEMA, "fingerprint": fp,
+                       "created_unix": time.time()}, f, indent=1)
+        os.replace(tmp, path)
+        return True
+    except OSError:
+        return False

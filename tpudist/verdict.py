@@ -89,6 +89,35 @@ def straggler_status(step_s_means, factor: float | None = None) -> str:
     return FAIL if max(valid) > factor * median else SUCCESS
 
 
+def tuning_status(mode: str, *, source: str = "heuristic",
+                  tuned_steps_per_sec: float | None = None,
+                  baseline_steps_per_sec: float | None = None) -> str:
+    """Three-valued autotune verdict (tpudist.tune) for the run log +
+    ``kind=timing`` record: UNGATEABLE when tuning was off (nothing
+    measured, nothing to certify) or a ``cache-only`` run missed the
+    cache (running on heuristics by explicit request); SUCCESS when a
+    measured operating point was committed — from the cache, or from a
+    probe search whose commit did not regress the measured seed
+    heuristic (the search guarantees this; the check here keeps the
+    verdict honest against future search bugs); FAIL when ``probe`` mode
+    had to fall back (probing errored, or every point was pruned) or the
+    committed point measured slower than the heuristic start. Advisory,
+    like the staging/straggler gates — a run that trains correctly on
+    the heuristics is a perf finding, not a correctness failure."""
+    if mode == "off":
+        return UNGATEABLE
+    if source == "cache":
+        return SUCCESS
+    if source == "probe":
+        # a dead heuristic start (baseline 0: the guess itself OOMed)
+        # with a live tuned point is the tuner WORKING, not a regression
+        if tuned_steps_per_sec and tuned_steps_per_sec >= (
+                baseline_steps_per_sec or 0.0):
+            return SUCCESS
+        return FAIL
+    return UNGATEABLE if mode == "cache-only" else FAIL
+
+
 def _write(path: str, content: str) -> None:
     if path.startswith("gs://"):
         # shell-free: path/content go as argv/stdin, immune to metacharacters
