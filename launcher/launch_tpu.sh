@@ -31,10 +31,15 @@
 #                       and the workload's own stall watchdog (default
 #                       --stall-timeout-s 300) dumps flightrec.worker<i>
 #                       diagnostics well before it fires
-#   OBS_DIR             on-worker directory for heartbeat beacons and
-#                       flight-record dumps (default /tmp/tpudist_obs);
-#                       collected to ./flightrec_artifacts/ on any
-#                       workload failure or timeout
+#   OBS_DIR             on-worker directory for heartbeat beacons,
+#                       flight-record dumps and span traces (default
+#                       /tmp/tpudist_obs); collected to
+#                       ./flightrec_artifacts/ on any workload failure
+#                       or timeout. On success the coordinator's merged
+#                       pod_trace.json (one Perfetto track per host)
+#                       plus the offline run report
+#                       (run_report.json/.md, python -m
+#                       tpudist.obs.report) are pulled instead.
 #   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
 #                       (debugging a slice with a known-red kernel)
 #   SKIP_TESTS_TPU=1    bypass the on-chip pytest lane (tests_tpu/)
@@ -219,9 +224,12 @@ fi
 # -k 60: SIGTERM first (the workload converts it into an orderly exit
 # that flushes metrics and writes its fail verdict), SIGKILL 60s later
 # if even that wedges
+# --trace-dir: span traces land in OBS_DIR too, so the same collection
+# path covers the timeline artifacts (trace.worker<i>.json on every
+# worker; the coordinator's merged pod_trace.json on success)
 set +e
 tpu_ssh all "timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
-  --heartbeat-dir $OBS_DIR$EXTRA_Q"
+  --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$EXTRA_Q"
 RC=$?
 set -e
 
@@ -256,6 +264,28 @@ if [ $RC -ne 0 ]; then
 fi
 echo "✅ distributed TPU job succeeded"
 echo -n success | gsutil cp - "$GCS_VERDICT"
+
+# ---- merged trace + offline run report off the coordinator -----------------
+# The coordinator holds the merged pod timeline (pod_trace.json, one
+# Perfetto track per host). Turn it + metrics.jsonl into the offline run
+# report ON the worker (the report CLI is jax-free), then pull all three
+# alongside where the failure path would put flight records. Best-effort:
+# a missing report must not repaint a green run red. metrics.jsonl lives
+# under the workload's --save-dir (default ckpt/ in the ssh home dir);
+# an operator overriding --save-dir also gets the report via the scp'd
+# pod_trace.json and a local re-run of the report CLI.
+tpu_ssh 0 "$RUN_PREFIX python3 -m tpudist.obs.report --run-dir $OBS_DIR \
+  --metrics ckpt/metrics.jsonl \
+  --out-json $OBS_DIR/run_report.json \
+  --out-md $OBS_DIR/run_report.md" || true
+mkdir -p flightrec_artifacts
+gcloud compute tpus tpu-vm scp \
+  "$TPU_NAME:$OBS_DIR/pod_trace.json" \
+  "$TPU_NAME:$OBS_DIR/run_report.json" \
+  "$TPU_NAME:$OBS_DIR/run_report.md" \
+  flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
+  --worker=0 2>/dev/null || true
+ls -l flightrec_artifacts/ 2>/dev/null || true
 
 # ---- gated bandwidth sweep (while the slice is alive) ----------------------
 SWEEP_RC=0

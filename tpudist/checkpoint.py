@@ -32,6 +32,8 @@ from typing import Any, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from tpudist.obs import trace as trace_lib
+
 DEFAULT_KEEP = 3
 
 
@@ -110,22 +112,27 @@ class Checkpointer:
         minus the redundant copies).
         """
         t0 = time.perf_counter()
-        self._mgr.save(int(state.step), args=ocp.args.Composite(
-            state=ocp.args.StandardSave(state),
-            meta=ocp.args.JsonSave({"epoch": int(epoch),
-                                    "step_in_epoch": int(step_in_epoch)})))
+        with trace_lib.span("ckpt_enqueue", cat="ckpt",
+                            step=int(state.step)):
+            self._mgr.save(int(state.step), args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                meta=ocp.args.JsonSave({
+                    "epoch": int(epoch),
+                    "step_in_epoch": int(step_in_epoch)})))
         self.last_enqueue_ms = (time.perf_counter() - t0) * 1000
         self.saves += 1
 
     def wait(self) -> None:
         t0 = time.perf_counter()
-        self._mgr.wait_until_finished()
+        with trace_lib.span("ckpt_drain", cat="ckpt"):
+            self._mgr.wait_until_finished()
         self.last_drain_ms = (time.perf_counter() - t0) * 1000
         self.drain_ms += self.last_drain_ms
 
     def close(self) -> None:
         t0 = time.perf_counter()
-        self._mgr.close()   # drains outstanding async writes
+        with trace_lib.span("ckpt_drain", cat="ckpt", close=True):
+            self._mgr.close()   # drains outstanding async writes
         self.last_drain_ms = (time.perf_counter() - t0) * 1000
         self.drain_ms += self.last_drain_ms
 

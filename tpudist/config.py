@@ -127,6 +127,12 @@ class TrainConfig:
     # None = $TPUDIST_AUTOTUNE_CACHE_DIR, else <save_dir>/tune
     autotune_trials: int = 0      # probe-trial budget; 0 = auto
     # ($TPUDIST_AUTOTUNE_TRIALS, else 12)
+    trace: Optional[str] = None   # on | off — host-side span tracing
+    # (obs.trace): ALWAYS ON by default; None = $TPUDIST_TRACE, else on.
+    # Run end exports trace.worker<i>.json per process and a merged
+    # pod_trace.json on the coordinator (one Perfetto track per host)
+    trace_dir: Optional[str] = None  # where trace artifacts land.
+    # None = $TPUDIST_TRACE_DIR, else save_dir (next to metrics.jsonl)
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -282,6 +288,35 @@ def resolve_autotune_trials(cfg: TrainConfig) -> int:
         return cfg.autotune_trials
     env = _env_float("TPUDIST_AUTOTUNE_TRIALS")
     return int(env) if env and env > 0 else AUTOTUNE_DEFAULT_TRIALS
+
+
+# Span tracing (tpudist.obs.trace): always-on observability, like the
+# flight recorder — the escape hatch exists for runs measuring the last
+# microsecond of host overhead, not as the default posture.
+TRACE_MODES = ("on", "off")
+
+
+def resolve_trace(cfg: TrainConfig) -> tuple[bool, str]:
+    """Resolve the span-tracer knobs to ``(enabled, trace_dir)``.
+
+    Precedence per knob: explicit flag > env var > default (on,
+    ``save_dir``). ``TPUDIST_TRACE`` accepts the usual falsy spellings
+    (off/0/false/no) so launchers can disable tracing pod-wide without
+    touching per-worker argv."""
+    mode = cfg.trace
+    if mode is None:
+        # single source of truth for the accepted falsy spellings: the
+        # ambient tracer (obs.trace.get, used by bench/selfcheck paths
+        # that never call this resolver) parses the same env the same
+        # way. Lazy import: config must stay importable before jax.
+        from tpudist.obs.trace import _env_enabled
+        mode = "on" if _env_enabled() else "off"
+    if mode not in TRACE_MODES:
+        raise ValueError(
+            f"--trace must be one of {TRACE_MODES}, got {mode!r}")
+    out_dir = (cfg.trace_dir or os.environ.get("TPUDIST_TRACE_DIR")
+               or cfg.save_dir)
+    return mode == "on", out_dir
 
 
 # Flight-recorder defaults: the stall window must comfortably exceed any
@@ -478,8 +513,21 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                         "(0 = $TPUDIST_AUTOTUNE_TRIALS, else 12)")
     p.add_argument("--profile-dir", type=str, default=None,
                    help="write jax.profiler traces (tensorboard format) "
-                        "here; the reference had no profiling at all "
-                        "(SURVEY.md §5.1)")
+                        "here — EVERY worker captures, into "
+                        "profile/worker<i> subdirs, so multi-host "
+                        "traces are complete; the reference had no "
+                        "profiling at all (SURVEY.md §5.1)")
+    p.add_argument("--trace", type=str, default=None,
+                   choices=list(TRACE_MODES),
+                   help="host-side span tracing (obs.trace): on by "
+                        "default (~1 µs/span); run end writes "
+                        "trace.worker<i>.json per process and a merged "
+                        "Perfetto pod_trace.json on the coordinator "
+                        "(default: $TPUDIST_TRACE, else on)")
+    p.add_argument("--trace-dir", type=str, default=None,
+                   help="directory for trace.worker<i>.json / "
+                        "pod_trace.json (default: $TPUDIST_TRACE_DIR, "
+                        "else --save-dir)")
     args = p.parse_known_args(argv)[0]
 
     return TrainConfig(
@@ -512,6 +560,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         autotune=args.autotune,
         autotune_cache_dir=args.autotune_cache_dir,
         autotune_trials=args.autotune_trials,
+        trace=args.trace,
+        trace_dir=args.trace_dir,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

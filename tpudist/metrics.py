@@ -17,6 +17,8 @@ from typing import IO, Any, Dict, List, Optional
 
 import jax
 
+from tpudist.obs import trace as trace_lib
+
 
 def log0(msg: str) -> None:
     """Rank-0-gated print (parity: reference ``train.py:120-121,128``)."""
@@ -62,7 +64,8 @@ class StepTimer:
         if result is not None:
             # fence via host TRANSFER, not block_until_ready: on tunneled
             # PJRT backends the latter can return before execution completes
-            jax.device_get(result)
+            with trace_lib.span("fence", cat="dispatch", steps=n):
+                jax.device_get(result)
         dt = time.perf_counter() - self.t0
         self._seen += 1
         if self._seen <= self.warmup:
@@ -125,7 +128,11 @@ class MetricsLogger:
     def log(self, **kv) -> None:
         if jax.process_index() != 0:
             return
-        rec = dict(ts=time.time(), **kv)
+        # both clocks on every record: wall ``ts`` for humans/dashboards,
+        # monotonic ``mono`` (same perf_counter timebase as the span
+        # tracer's microsecond stamps) so the offline report CLI aligns
+        # metrics with trace spans without trusting NTP
+        rec = dict(ts=time.time(), mono=time.perf_counter(), **kv)
         with self._lock:
             self.history.append(rec)
             if self.path:
@@ -200,7 +207,8 @@ class StagingStats:
         """Block until ``slab``'s transfer lands; account the exposed
         time. Called with the previous slab's compute already drained."""
         t0 = time.perf_counter()
-        jax.block_until_ready(slab)
+        with trace_lib.span("slab_wait", cat="staging"):
+            jax.block_until_ready(slab)
         dt = time.perf_counter() - t0
         self.wait_s += dt
         return dt

@@ -11,7 +11,11 @@ of a timeout (DESIGN.md "Observability"):
   * :mod:`hoststats` — epoch-end per-host step-time aggregation and
     the three-valued straggler verdict;
   * :mod:`mfu` — MFU/roofline accounting from the compiled program's
-    own cost analysis.
+    own cost analysis;
+  * :mod:`trace` — host-side span tracer (ring buffers, Chrome
+    trace-event export, pod-merged Perfetto timeline);
+  * :mod:`report` — the offline run-report CLI over the merged trace
+    plus ``metrics.jsonl`` (``python -m tpudist.obs.report``).
 
 :class:`PodObserver` is the facade the train loop wires through: one
 object to start, feed progress, ask for record fields, and close.
@@ -21,7 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from tpudist.obs import flightrec, hbm, heartbeat, hoststats, mfu
+from tpudist.obs import flightrec, hbm, heartbeat, hoststats, mfu, trace
 from tpudist.obs.flightrec import dump_flight_record
 from tpudist.obs.hbm import HbmSampler
 from tpudist.obs.heartbeat import FlightRecorder
@@ -29,7 +33,7 @@ from tpudist.obs.hoststats import HostStepStats
 
 __all__ = ["FlightRecorder", "HbmSampler", "HostStepStats", "PodObserver",
            "dump_flight_record", "flightrec", "hbm", "heartbeat",
-           "hoststats", "mfu"]
+           "hoststats", "mfu", "trace"]
 
 
 class PodObserver:
@@ -52,7 +56,8 @@ class PodObserver:
         self.recorder = FlightRecorder(
             out_dir, stall_timeout_s=stall_timeout_s,
             process_index=process_index, metrics=metrics,
-            extra_state=(self.hbm.split if self.hbm else None))
+            extra_state=(self.hbm.split if self.hbm else None),
+            tracer=trace.get())
         self._closed = False
 
     @classmethod

@@ -67,6 +67,7 @@ def dump_flight_record(path: str, *, reason: str,
                        progress: Optional[Dict[str, Any]] = None,
                        stall_s: Optional[float] = None,
                        last_metrics: Optional[List[Dict]] = None,
+                       spans: Optional[List[Dict]] = None,
                        extra: Optional[Dict[str, Any]] = None) -> str:
     """Write one flight-record artifact to ``path`` and return the path.
 
@@ -74,7 +75,9 @@ def dump_flight_record(path: str, *, reason: str,
     ``reason`` (why the dump fired), ``progress`` (the last beacon:
     step/epoch/phase/ts), ``thread_stacks`` (faulthandler text),
     ``memory_stats`` (per device), ``last_metrics`` (tail of the
-    in-memory record history), and any ``extra`` observer state (HBM
+    in-memory record history), ``spans`` (the span tracer's per-thread
+    buffer tails + open-span stacks — what phase each thread was in
+    when the dump fired), and any ``extra`` observer state (HBM
     watermarks). Atomic write: tmp + ``os.replace``."""
     payload: Dict[str, Any] = {
         "schema": FLIGHTREC_SCHEMA_VERSION,
@@ -87,6 +90,7 @@ def dump_flight_record(path: str, *, reason: str,
         "thread_stacks": thread_stacks(),
         "memory_stats": collect_memory_stats(),
         "last_metrics": list(last_metrics or []),
+        "spans": spans,
     }
     if extra:
         payload["extra"] = extra

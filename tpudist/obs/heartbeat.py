@@ -56,12 +56,18 @@ class FlightRecorder:
         most in exactly the runs that die).
       * ``extra_state`` — optional callable returning a dict folded into
         the dump (the HBM sampler's watermarks ride along here).
+      * ``tracer`` — an ``obs.trace.Tracer``; the stall dump embeds the
+        tail of its span buffers (what phase each thread was in when
+        the run hung) and exports the worker's local Chrome trace next
+        to the flight record — a hung run leaves its TIMELINE, not
+        just its stacks.
     """
 
     def __init__(self, out_dir: str, *, stall_timeout_s: float = 300.0,
                  process_index: int = 0, metrics: Any = None,
                  extra_state: Optional[Callable[[], Dict]] = None,
-                 last_n_metrics: int = 50):
+                 tracer: Any = None, last_n_metrics: int = 50,
+                 last_n_spans: int = 64):
         if stall_timeout_s < 0:
             raise ValueError(
                 f"stall_timeout_s must be >= 0, got {stall_timeout_s}")
@@ -70,7 +76,9 @@ class FlightRecorder:
         self.process_index = process_index
         self.metrics = metrics
         self.extra_state = extra_state
+        self.tracer = tracer
         self.last_n_metrics = last_n_metrics
+        self.last_n_spans = last_n_spans
         self.beacon_path = os.path.join(
             out_dir, f"heartbeat.worker{process_index}")
         self.flightrec_path = os.path.join(
@@ -155,9 +163,30 @@ class FlightRecorder:
                 extra = self.extra_state()
             except Exception:
                 extra = None
+        spans = None
+        if self.tracer is not None and getattr(self.tracer, "enabled",
+                                               False):
+            # the span-buffer tail: WHAT PHASE each thread was in when
+            # the run hung (the open-span stack is the live answer) —
+            # and the full local timeline as a Chrome trace next to the
+            # flight record, since a wedged pod never reaches the
+            # run-end merged export (its collectives would hang too)
+            try:
+                spans = self.tracer.tail(per_thread=self.last_n_spans)
+            except Exception:
+                spans = None
+            try:
+                from tpudist.obs import trace as trace_mod
+                self.tracer.export_local(
+                    os.path.join(self.out_dir, trace_mod.worker_trace_name(
+                        self.process_index)),
+                    process_index=self.process_index)
+            except Exception:
+                pass
         path = flightrec.dump_flight_record(
             self.flightrec_path, reason=reason, progress=self._progress,
-            stall_s=stall_s, last_metrics=history, extra=extra)
+            stall_s=stall_s, last_metrics=history, spans=spans,
+            extra=extra)
         if self.metrics is not None:
             # the buffered JSONL stream would otherwise die with the run
             # — these are the records that matter most (satellite:

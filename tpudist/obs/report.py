@@ -1,0 +1,489 @@
+"""Offline run report: ``python -m tpudist.obs.report --run-dir DIR``.
+
+The acceptance-test philosophy (the container-HPC workflow of
+arXiv:2208.02498) is that the run itself must emit the artifacts that
+explain a failure. This CLI is the explainer: it ingests a finished
+run's ``metrics.jsonl`` and merged ``pod_trace.json`` (plus an optional
+baseline) and emits ``run_report.json`` + a human ``run_report.md``
+with:
+
+  * per-host, per-phase wall-time breakdown (SELF time: nested child
+    spans are subtracted from their parents, so the phase totals are
+    mutually exclusive and sum to the traced coverage of the run);
+  * exposed-vs-overlapped staging time (``slab_wait`` spans = H2D the
+    pipeline failed to hide; ``stage_slab`` = host staging work that
+    overlapped compute);
+  * straggler attribution BY PHASE: not just "host 3 was slow" but
+    which phase put it behind the pod median;
+  * checkpoint-drain stalls (enqueue vs drain blocked time);
+  * a regression verdict against a baseline steps/s.
+
+Offline by design: no jax import, no device touch — it runs on a
+laptop against scp'd artifacts from a dead pod.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence
+
+REPORT_SCHEMA_VERSION = 1
+
+SUCCESS = "success"
+FAIL = "fail"
+UNGATEABLE = "ungateable"
+
+# Regression gate: measured steps/s below this fraction of baseline is
+# a FAIL. Same advisory three-valued shape as the staging/straggler
+# gates; override via --regress-min or TPUDIST_REGRESS_MIN.
+REGRESS_MIN_FRACTION = 0.8
+
+# A host whose per-phase self time exceeds the pod median by this many
+# seconds AND this factor is attributed as a straggler cause.
+ATTRIB_FACTOR = 1.25
+ATTRIB_MIN_S = 0.05
+
+
+# ----------------------------------------------------------- ingestion
+
+
+def load_metrics(path: str) -> List[Dict[str, Any]]:
+    recs = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                recs.append(json.loads(line))
+    return recs
+
+
+def load_trace(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        doc = json.load(f)
+    if "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace-event document "
+                         f"(no traceEvents key)")
+    return doc
+
+
+def complete_events(doc: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """The 'X' (complete) events — the spans."""
+    return [e for e in doc.get("traceEvents", [])
+            if e.get("ph") == "X" and "ts" in e and "dur" in e]
+
+
+# -------------------------------------------------- self-time analysis
+
+
+def self_times(events: List[Dict[str, Any]]) -> Dict[int, Dict[str, Any]]:
+    """Per-host phase breakdown from span SELF times.
+
+    Spans on one thread nest properly (the tracer records them from a
+    stack discipline), so each span's self time is its duration minus
+    the time covered by its children; summing self times per category
+    yields mutually-exclusive phase totals whose sum equals the union
+    of traced time on that thread. Returns, per pid::
+
+        {"wall_s", "covered_s", "coverage", "phases": {cat: s},
+         "names": {name: {"s", "count"}}, "spans"}
+    """
+    by_host: Dict[int, Dict[str, Any]] = {}
+    by_pid_tid: Dict[tuple, List[Dict[str, Any]]] = {}
+    for e in events:
+        by_pid_tid.setdefault((e.get("pid", 0), e.get("tid", 0)),
+                              []).append(e)
+
+    for (pid, _tid), evs in by_pid_tid.items():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        host = by_host.setdefault(
+            pid, {"t_min": None, "t_max": None,
+                  "phases": {}, "names": {}, "spans": 0})
+        # stack of [end_ts, child_covered_us] for open ancestors
+        stack: List[List[float]] = []
+        for e in evs:
+            ts, dur = float(e["ts"]), float(e["dur"])
+            end = ts + dur
+            host["t_min"] = ts if host["t_min"] is None else min(
+                host["t_min"], ts)
+            host["t_max"] = end if host["t_max"] is None else max(
+                host["t_max"], end)
+            host["spans"] += 1
+            while stack and stack[-1][0] <= ts + 1e-9:
+                stack.pop()
+            if stack:
+                stack[-1][1] += dur     # covered inside the parent
+            stack.append([end, 0.0])
+            # self time resolves when the span closes; with sorted input
+            # all children arrive before the next sibling, but their
+            # durations accumulate into slot [1] as they are visited —
+            # record a placeholder now and fix up after the pass
+            e["_self_slot"] = stack[-1]
+        for e in evs:
+            ts, dur = float(e["ts"]), float(e["dur"])
+            self_us = max(0.0, dur - e["_self_slot"][1])
+            del e["_self_slot"]
+            cat = e.get("cat", "misc")
+            host["phases"][cat] = host["phases"].get(cat, 0.0) + self_us
+            n = host["names"].setdefault(e.get("name", "?"),
+                                         {"s": 0.0, "count": 0})
+            n["s"] += dur / 1e6
+            n["count"] += 1
+
+    out: Dict[int, Dict[str, Any]] = {}
+    for pid, h in sorted(by_host.items()):
+        wall_us = ((h["t_max"] - h["t_min"])
+                   if h["t_max"] is not None else 0.0)
+        phases = {c: round(us / 1e6, 6) for c, us in
+                  sorted(h["phases"].items(), key=lambda kv: -kv[1])}
+        covered = sum(phases.values())
+        out[pid] = {
+            "wall_s": round(wall_us / 1e6, 6),
+            "covered_s": round(covered, 6),
+            "coverage": (round(covered / (wall_us / 1e6), 4)
+                         if wall_us > 0 else None),
+            "phases": phases,
+            "names": {k: {"s": round(v["s"], 6), "count": v["count"]}
+                      for k, v in sorted(h["names"].items(),
+                                         key=lambda kv: -kv[1]["s"])},
+            "spans": h["spans"],
+        }
+    return out
+
+
+def _sum_named(events: List[Dict[str, Any]], *,
+               names: Optional[set] = None,
+               cat: Optional[str] = None,
+               pid: Optional[int] = None) -> float:
+    """Total duration (s) of spans matching name/cat/pid filters."""
+    tot = 0.0
+    for e in events:
+        if names is not None and e.get("name") not in names:
+            continue
+        if cat is not None and e.get("cat") != cat:
+            continue
+        if pid is not None and e.get("pid") != pid:
+            continue
+        tot += float(e["dur"]) / 1e6
+    return tot
+
+
+# ----------------------------------------------------------- sections
+
+
+def staging_section(events, timing: Optional[Dict]) -> Dict[str, Any]:
+    """Exposed vs overlapped staging: ``slab_wait`` spans are the H2D
+    the pipeline failed to hide behind compute; ``stage_slab`` is the
+    host-side materialise+dispatch work that DID overlap."""
+    exposed = _sum_named(events, names={"slab_wait"})
+    staged = _sum_named(events, names={"stage_slab"})
+    sec = {
+        "exposed_wait_s": round(exposed, 6),
+        "stage_host_s": round(staged, 6),
+        "overlapped_s": round(max(0.0, staged - exposed), 6),
+        "slabs": sum(1 for e in events if e.get("name") == "stage_slab"),
+    }
+    if timing:
+        sec["timing_stage_wait_s"] = timing.get("stage_wait_s")
+        sec["staging_status"] = timing.get("staging_status")
+        sec["overlap_fraction"] = timing.get("staging_overlap_fraction")
+    return sec
+
+
+def ckpt_section(events, metrics) -> Dict[str, Any]:
+    """Checkpoint cost split: per-save enqueue (what the step path
+    paid) vs drain (time blocked on serialisation at wait/close)."""
+    drains = [e for e in events if e.get("cat") == "ckpt"
+              and "drain" in e.get("name", "")]
+    enq = _sum_named(events, names={"ckpt_enqueue"})
+    drain_recs = [r for r in metrics if r.get("kind") == "ckpt_drain"]
+    saves = [r for r in metrics if r.get("kind") == "ckpt"]
+    worst = max((float(e["dur"]) / 1e6 for e in drains), default=0.0)
+    return {
+        "saves": len(saves),
+        "enqueue_s": round(enq, 6),
+        "drain_s": round(sum(float(e["dur"]) / 1e6 for e in drains), 6),
+        "drain_spans": len(drains),
+        "worst_drain_s": round(worst, 6),
+        "timing_drain_ms": (drain_recs[-1].get("drain_ms")
+                            if drain_recs else None),
+    }
+
+
+def straggler_section(hosts: Dict[int, Dict[str, Any]],
+                      metrics) -> Dict[str, Any]:
+    """Straggler attribution BY PHASE: for each host, which phase's
+    self time exceeds the pod median of that phase. With < 2 hosts
+    there is nothing to compare — ungateable, like the live verdict."""
+    import statistics
+    hosts_rec = [r for r in metrics if r.get("kind") == "hosts"]
+    status = (hosts_rec[-1].get("straggler_status")
+              if hosts_rec else UNGATEABLE)
+    if len(hosts) < 2:
+        return {"status": status if hosts_rec else UNGATEABLE,
+                "attribution": []}
+    cats = sorted({c for h in hosts.values() for c in h["phases"]})
+    attribution = []
+    for cat in cats:
+        vals = {pid: h["phases"].get(cat, 0.0)
+                for pid, h in hosts.items()}
+        med = statistics.median(vals.values())
+        for pid, v in vals.items():
+            if v > ATTRIB_FACTOR * med and v - med > ATTRIB_MIN_S:
+                attribution.append({
+                    "process": pid, "phase": cat,
+                    "self_s": round(v, 6),
+                    "pod_median_s": round(med, 6),
+                    "excess_s": round(v - med, 6)})
+    attribution.sort(key=lambda a: -a["excess_s"])
+    return {"status": status, "attribution": attribution}
+
+
+def regression_section(timing: Optional[Dict],
+                       baseline: Optional[Dict],
+                       min_fraction: float) -> Dict[str, Any]:
+    """Measured steps/s vs baseline. Baseline JSON: any dict carrying
+    ``steps_per_sec`` (a prior run_report.json, a BENCH row, or a
+    hand-written pin). No baseline / no measurement → ungateable."""
+    measured = None
+    if timing and timing.get("run_s") and timing.get("steps"):
+        measured = timing["steps"] / timing["run_s"]
+    base = _find_steps_per_sec(baseline) if baseline else None
+    if measured is None or base is None or base <= 0:
+        return {"status": UNGATEABLE, "steps_per_sec": measured,
+                "baseline_steps_per_sec": base, "ratio": None,
+                "min_fraction": min_fraction}
+    ratio = measured / base
+    return {"status": SUCCESS if ratio >= min_fraction else FAIL,
+            "steps_per_sec": round(measured, 4),
+            "baseline_steps_per_sec": round(base, 4),
+            "ratio": round(ratio, 4), "min_fraction": min_fraction}
+
+
+def _find_steps_per_sec(doc: Any) -> Optional[float]:
+    """Dig a steps/s number out of a baseline document: top-level
+    ``steps_per_sec``, a run_report's ``regression.steps_per_sec``, or
+    a ``run.steps_per_sec``."""
+    if not isinstance(doc, dict):
+        return None
+    for path in (("steps_per_sec",),
+                 ("run", "steps_per_sec"),
+                 ("regression", "steps_per_sec")):
+        cur: Any = doc
+        for k in path:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, (int, float)) and cur > 0:
+            return float(cur)
+    return None
+
+
+# -------------------------------------------------------- the report
+
+
+def build_report(metrics: List[Dict[str, Any]],
+                 trace_doc: Dict[str, Any], *,
+                 baseline: Optional[Dict] = None,
+                 regress_min: Optional[float] = None) -> Dict[str, Any]:
+    if regress_min is None:
+        try:
+            regress_min = float(os.environ.get(
+                "TPUDIST_REGRESS_MIN", REGRESS_MIN_FRACTION))
+        except ValueError:
+            regress_min = REGRESS_MIN_FRACTION
+    events = complete_events(trace_doc)
+    hosts = self_times(events)
+    timings = [r for r in metrics if r.get("kind") == "timing"]
+    timing = timings[-1] if timings else None
+    epochs = [r for r in metrics if r.get("kind") == "epoch"]
+    tunes = [r for r in metrics if r.get("kind") == "tune"]
+
+    regression = regression_section(timing, baseline, regress_min)
+    stragglers = straggler_section(hosts, metrics)
+    # pod-level phase totals (sum over hosts)
+    pod_phases: Dict[str, float] = {}
+    for h in hosts.values():
+        for c, s in h["phases"].items():
+            pod_phases[c] = pod_phases.get(c, 0.0) + s
+
+    verdict = SUCCESS
+    if regression["status"] == FAIL or stragglers["status"] == FAIL:
+        verdict = FAIL
+    elif not events:
+        verdict = UNGATEABLE
+
+    return {
+        "schema": REPORT_SCHEMA_VERSION,
+        "run": {
+            "steps": timing.get("steps") if timing else None,
+            "run_s": timing.get("run_s") if timing else None,
+            "compile_warmup_s": (timing.get("compile_warmup_s")
+                                 if timing else None),
+            "steps_per_sec": regression["steps_per_sec"],
+            "epochs": len(epochs),
+            "final_avg_loss": (epochs[-1].get("avg_loss")
+                               if epochs else None),
+            "staging_status": (timing.get("staging_status")
+                               if timing else None),
+            "tuning_status": (tunes[-1].get("status") if tunes
+                              else (timing or {}).get("tuning_status")),
+            "straggler_status": stragglers["status"],
+            "trace_status": (timing.get("trace_status")
+                             if timing else None),
+        },
+        "trace": {
+            "hosts": trace_doc.get("metadata", {}).get("hosts", 1),
+            "spans": len(events),
+            "dropped": trace_doc.get("metadata", {}).get("dropped", 0),
+            "clock_offsets_ns": trace_doc.get("metadata", {}).get(
+                "clock_offsets_ns"),
+        },
+        "hosts": {str(pid): h for pid, h in hosts.items()},
+        "pod_phases": {c: round(s, 6) for c, s in
+                       sorted(pod_phases.items(), key=lambda kv: -kv[1])},
+        "staging": staging_section(events, timing),
+        "ckpt": ckpt_section(events, metrics),
+        "stragglers": stragglers,
+        "regression": regression,
+        "verdict": verdict,
+    }
+
+
+def to_markdown(report: Dict[str, Any]) -> str:
+    """The human half of the artifact pair."""
+    r = report
+    lines = ["# tpudist run report", ""]
+    run = r["run"]
+    lines += [f"**Verdict: {r['verdict']}** — regression "
+              f"{r['regression']['status']}, stragglers "
+              f"{r['stragglers']['status']}, staging "
+              f"{run.get('staging_status')}, tuning "
+              f"{run.get('tuning_status')}", ""]
+    if run.get("run_s"):
+        sps = run.get("steps_per_sec")
+        warm = run.get("compile_warmup_s")
+        lines += [f"- steady-state: {run['steps']} steps in "
+                  f"{run['run_s']:.3f}s"
+                  + (f" ({sps:.2f} steps/s)" if sps else ""),
+                  f"- compile+warmup: "
+                  + (f"{warm:.3f}s" if warm is not None else "—"),
+                  f"- epochs: {run['epochs']}, final avg loss "
+                  f"{run.get('final_avg_loss')}", ""]
+    reg = r["regression"]
+    if reg["status"] != UNGATEABLE:
+        lines += [f"- regression gate: {reg['steps_per_sec']} vs baseline "
+                  f"{reg['baseline_steps_per_sec']} steps/s (ratio "
+                  f"{reg['ratio']}, floor {reg['min_fraction']}) → "
+                  f"**{reg['status']}**", ""]
+    lines += ["## Per-host phase breakdown (span self time)", ""]
+    cats = list(r["pod_phases"].keys())
+    lines += ["| host | wall s | coverage | "
+              + " | ".join(cats) + " |",
+              "|---|---|---|" + "---|" * len(cats)]
+    for pid, h in r["hosts"].items():
+        row = [f"host{pid}", f"{h['wall_s']:.3f}",
+               f"{h['coverage']:.0%}" if h["coverage"] else "—"]
+        row += [f"{h['phases'].get(c, 0.0):.3f}" for c in cats]
+        lines.append("| " + " | ".join(row) + " |")
+    lines.append("")
+    st = r["staging"]
+    lines += ["## Staging",
+              f"- exposed H2D wait: {st['exposed_wait_s']:.3f}s over "
+              f"{st['slabs']} slabs; host staging work "
+              f"{st['stage_host_s']:.3f}s "
+              f"(overlapped ≈ {st['overlapped_s']:.3f}s)", ""]
+    ck = r["ckpt"]
+    lines += ["## Checkpointing",
+              f"- {ck['saves']} saves, enqueue {ck['enqueue_s']:.3f}s, "
+              f"drain {ck['drain_s']:.3f}s over {ck['drain_spans']} "
+              f"drain windows (worst {ck['worst_drain_s']:.3f}s)", ""]
+    if r["stragglers"]["attribution"]:
+        lines += ["## Straggler attribution", ""]
+        for a in r["stragglers"]["attribution"]:
+            lines.append(
+                f"- host{a['process']}: **{a['phase']}** self time "
+                f"{a['self_s']:.3f}s vs pod median "
+                f"{a['pod_median_s']:.3f}s (+{a['excess_s']:.3f}s)")
+        lines.append("")
+    tr = r["trace"]
+    lines += [f"_trace: {tr['spans']} spans from {tr['hosts']} host(s), "
+              f"{tr['dropped']} dropped_", ""]
+    return "\n".join(lines)
+
+
+# -------------------------------------------------------------- CLI
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.obs.report",
+        description="offline tpudist run report from metrics.jsonl + "
+                    "pod_trace.json")
+    p.add_argument("--run-dir", type=str, default=None,
+                   help="directory holding metrics.jsonl and "
+                        "pod_trace.json (a train run's --save-dir)")
+    p.add_argument("--metrics", type=str, default=None,
+                   help="explicit metrics.jsonl path")
+    p.add_argument("--trace", type=str, default=None,
+                   help="explicit pod_trace.json (or trace.worker<i>."
+                        "json) path")
+    p.add_argument("--baseline", type=str, default=None,
+                   help="baseline JSON carrying steps_per_sec (e.g. a "
+                        "prior run_report.json) for the regression gate")
+    p.add_argument("--regress-min", type=float, default=None,
+                   help=f"regression floor as a fraction of baseline "
+                        f"steps/s (default $TPUDIST_REGRESS_MIN, else "
+                        f"{REGRESS_MIN_FRACTION})")
+    p.add_argument("--out-json", type=str, default=None,
+                   help="run_report.json path (default: <run-dir>/"
+                        "run_report.json)")
+    p.add_argument("--out-md", type=str, default=None,
+                   help="run_report.md path (default: <run-dir>/"
+                        "run_report.md)")
+    args = p.parse_args(argv)
+
+    run_dir = args.run_dir or "."
+    metrics_path = args.metrics or os.path.join(run_dir, "metrics.jsonl")
+    trace_path = args.trace
+    if trace_path is None:
+        trace_path = os.path.join(run_dir, "pod_trace.json")
+        if not os.path.exists(trace_path):
+            # single-worker fallback: the local export is the pod trace
+            alt = os.path.join(run_dir, "trace.worker0.json")
+            if os.path.exists(alt):
+                trace_path = alt
+    for path, what in ((metrics_path, "metrics"), (trace_path, "trace")):
+        if not os.path.exists(path):
+            print(f"tpudist.obs.report: missing {what} file {path}",
+                  file=sys.stderr)
+            return 2
+
+    metrics = load_metrics(metrics_path)
+    trace_doc = load_trace(trace_path)
+    baseline = None
+    if args.baseline:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+
+    report = build_report(metrics, trace_doc, baseline=baseline,
+                          regress_min=args.regress_min)
+    out_json = args.out_json or os.path.join(run_dir, "run_report.json")
+    out_md = args.out_md or os.path.join(run_dir, "run_report.md")
+    for path, payload in ((out_json, json.dumps(report, indent=1)),
+                          (out_md, to_markdown(report))):
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        tmp = f"{path}.tmp"
+        with open(tmp, "w") as f:
+            f.write(payload)
+        os.replace(tmp, path)
+    print(f"tpudist: run report {report['verdict']}: {out_json} "
+          f"({report['trace']['spans']} spans, "
+          f"{len(report['hosts'])} host(s))")
+    return 0 if report["verdict"] != FAIL else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -36,6 +36,7 @@ from tpudist import config as config_lib
 from tpudist.config import TrainConfig, parse_args
 from tpudist.metrics import (MetricsLogger, StagingStats, StepTimer,
                              device_kind, log0)
+from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
 
 
@@ -45,8 +46,16 @@ def run(cfg: TrainConfig) -> float:
     Raises on failure — ``main()`` turns exceptions into the fail verdict +
     nonzero exit (the srun-equivalent signal chain).
     """
-    ctx = distributed.initialize()
-    mesh = build_mesh(cfg.parallel)
+    # span tracing is ALWAYS ON (≈1 µs/span, host-side only — device
+    # math is untouched, so traced and untraced runs are bitwise
+    # identical); --trace off / TPUDIST_TRACE=off is the escape hatch.
+    # A fresh tracer per run: back-to-back runs in one process (tests,
+    # notebooks) must not mix spans.
+    trace_enabled, trace_dir = config_lib.resolve_trace(cfg)
+    tracer = trace_lib.configure(enabled=trace_enabled)
+    with trace_lib.span("distributed_init", cat="init"):
+        ctx = distributed.initialize()
+        mesh = build_mesh(cfg.parallel)
     log0(f"tpudist: {ctx.global_device_count} {device_kind()} device(s), "
          f"{ctx.process_count} process(es), mesh "
          f"{dict(zip(mesh.axis_names, mesh.devices.shape))}")
@@ -79,20 +88,22 @@ def run(cfg: TrainConfig) -> float:
     # and gathers host batches slab-wise on demand, so the streaming
     # staging loop below never needs the whole epoch in host or device
     # memory at once
-    if cfg.model.name == "mlp":
-        x, y = data_lib.make_synthetic_data(
-            cfg.data.n_samples, cfg.data.n_features, cfg.data.seed)
-        sources = (x, y)
-    else:
-        # seq_len+1 tokens: the causal shift consumes one, so the model
-        # sees exactly max_seq_len positions (divisible by the context axis)
-        sources = (data_lib.make_synthetic_tokens(
-            cfg.data.n_samples, cfg.model.max_seq_len + 1,
-            cfg.model.vocab_size, cfg.data.seed),)
-    # one D2H conversion for the whole run: EpochPlan gathers from host
-    # arrays, and converting per epoch would re-copy the entire dataset
-    # off the device every epoch
-    sources = tuple(np.asarray(a) for a in sources)
+    with trace_lib.span("data_materialize", cat="data"):
+        if cfg.model.name == "mlp":
+            x, y = data_lib.make_synthetic_data(
+                cfg.data.n_samples, cfg.data.n_features, cfg.data.seed)
+            sources = (x, y)
+        else:
+            # seq_len+1 tokens: the causal shift consumes one, so the
+            # model sees exactly max_seq_len positions (divisible by the
+            # context axis)
+            sources = (data_lib.make_synthetic_tokens(
+                cfg.data.n_samples, cfg.model.max_seq_len + 1,
+                cfg.model.vocab_size, cfg.data.seed),)
+        # one D2H conversion for the whole run: EpochPlan gathers from
+        # host arrays, and converting per epoch would re-copy the entire
+        # dataset off the device every epoch
+        sources = tuple(np.asarray(a) for a in sources)
 
     def epoch_plan(epoch):
         return data_lib.plan_epoch(
@@ -101,7 +112,9 @@ def run(cfg: TrainConfig) -> float:
             process_count=ctx.process_count)
 
     # --- model + engine (DeepSpeed-engine equivalent) ---
-    state = engine_lib.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    with trace_lib.span("model_init", cat="init"):
+        state = engine_lib.init_state(jax.random.PRNGKey(cfg.seed), cfg,
+                                      mesh)
 
     metrics = MetricsLogger(
         path=os.path.join(cfg.save_dir, "metrics.jsonl")
@@ -116,11 +129,12 @@ def run(cfg: TrainConfig) -> float:
     tuning_status = verdict_lib.tuning_status(autotune_mode)
     if autotune_mode != "off":
         from tpudist import tune as tune_lib
-        outcome = tune_lib.autotune(
-            cfg, mesh, epoch_plan(0), mode=autotune_mode, metrics=metrics,
-            is_coordinator=ctx.is_coordinator,
-            state_bytes=engine_lib.state_bytes_per_device(state),
-            hbm_bytes=engine_lib._device_hbm_bytes())
+        with trace_lib.span("autotune", cat="tune", mode=autotune_mode):
+            outcome = tune_lib.autotune(
+                cfg, mesh, epoch_plan(0), mode=autotune_mode,
+                metrics=metrics, is_coordinator=ctx.is_coordinator,
+                state_bytes=engine_lib.state_bytes_per_device(state),
+                hbm_bytes=engine_lib._device_hbm_bytes())
         cfg = outcome.cfg
         tuning_status = outcome.status
         t = outcome.tuned
@@ -154,19 +168,21 @@ def run(cfg: TrainConfig) -> float:
 
     # held-out eval batch (fresh seed): one forward per epoch strengthens
     # the convergence oracle beyond the reference's train-loss-only signal
-    if cfg.model.name == "mlp":
-        ev_x, ev_y = data_lib.make_synthetic_data(
-            cfg.batch_size, cfg.data.n_features, cfg.data.seed + 1)
-        eval_batch = (ev_x, ev_y)
-    else:
-        eval_batch = (data_lib.make_synthetic_tokens(
-            cfg.batch_size, cfg.model.max_seq_len + 1,
-            cfg.model.vocab_size, cfg.data.seed + 1),)
-    eval_fn = engine_lib.make_eval_fn(cfg, mesh)
+    with trace_lib.span("setup", cat="init"):
+        if cfg.model.name == "mlp":
+            ev_x, ev_y = data_lib.make_synthetic_data(
+                cfg.batch_size, cfg.data.n_features, cfg.data.seed + 1)
+            eval_batch = (ev_x, ev_y)
+        else:
+            eval_batch = (data_lib.make_synthetic_tokens(
+                cfg.batch_size, cfg.model.max_seq_len + 1,
+                cfg.model.vocab_size, cfg.data.seed + 1),)
+        eval_fn = engine_lib.make_eval_fn(cfg, mesh)
 
     start_epoch, start_step_in_epoch = 0, 0
     if cfg.resume:
-        restored = ckpt_lib.restore_latest_full(cfg.save_dir, state)
+        with trace_lib.span("resume_restore", cat="ckpt"):
+            restored = ckpt_lib.restore_latest_full(cfg.save_dir, state)
         if restored is not None:
             state, start_epoch, start_step_in_epoch = restored
             log0(f"Resumed at epoch {start_epoch}, step "
@@ -184,12 +200,20 @@ def run(cfg: TrainConfig) -> float:
 
     # one manager for the whole run: async saves overlap the next epoch's
     # steps (the old save-per-call shape implied a synchronous drain)
-    ckpt = ckpt_lib.Checkpointer(cfg.save_dir, use_async=not cfg.ckpt_sync)
+    with trace_lib.span("ckpt_open", cat="ckpt"):
+        ckpt = ckpt_lib.Checkpointer(cfg.save_dir,
+                                     use_async=not cfg.ckpt_sync)
 
     import contextlib
-    profile_cm = (jax.profiler.trace(cfg.profile_dir)
-                  if cfg.profile_dir and ctx.is_coordinator
+    # EVERY worker captures the profiler trace, into per-process
+    # subdirs (profile/worker<i>): a coordinator-only capture left
+    # multi-host traces blind to the other workers' device timelines,
+    # which is exactly where cross-host effects live
+    profile_cm = (jax.profiler.trace(os.path.join(
+                      cfg.profile_dir, f"worker{ctx.process_index}"))
+                  if cfg.profile_dir
                   else contextlib.nullcontext())
+    run_ok = False
     try:
         with profile_cm:
             last_avg = _epoch_loop(cfg, ctx, mesh, state, train_step,
@@ -199,6 +223,7 @@ def run(cfg: TrainConfig) -> float:
                                    superstep=superstep, k=k,
                                    budget_bytes=budget_bytes,
                                    staging=staging, observer=observer)
+        run_ok = True
     finally:
         observer.note_progress(phase="shutdown")
         ckpt.close()   # drain outstanding async writes before exiting
@@ -208,6 +233,19 @@ def run(cfg: TrainConfig) -> float:
         metrics.log(kind="ckpt_drain", drain_ms=round(ckpt.drain_ms, 1),
                     saves=ckpt.saves)
         observer.close()  # stop watchdog/sampler threads, final beacon
+        if tracer.enabled and not run_ok:
+            # a DYING run exports its local timeline only: the merged
+            # export's collectives would hang on whichever peer died
+            # first. Unconditional (atomic, idempotent): the watchdog
+            # may already have exported, but into the HEARTBEAT dir —
+            # trace_dir is where collection and the report CLI look
+            try:
+                tracer.export_local(
+                    os.path.join(trace_dir, trace_lib.worker_trace_name(
+                        ctx.process_index)),
+                    process_index=ctx.process_index)
+            except Exception:
+                pass
         metrics.close()  # flush the buffered JSONL stream even on failure
 
     log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
@@ -247,10 +285,44 @@ def run(cfg: TrainConfig) -> float:
              f" MB ({obs_fields['hbm_source']})"
              + (f", {100 * obs_fields['hbm_peak_fraction']:.1f}% of device"
                 if obs_fields.get("hbm_peak_fraction") else ""))
+    # run-end span export: every worker writes trace.worker<i>.json,
+    # clock offsets come from a barrier-bracketed allgather probe, and
+    # the coordinator merges one Perfetto track per host into
+    # pod_trace.json. A COLLECTIVE — but this is the success path, all
+    # hosts reach it (a dying run took the local-only export above).
+    trace_summary = None
+    trace_err = None
+    if tracer.enabled:
+        try:
+            trace_summary = trace_lib.export_pod_trace(
+                trace_dir, process_index=ctx.process_index,
+                process_count=ctx.process_count, tracer=tracer)
+        except Exception as e:   # observability must never fail the run
+            trace_err = e
+    trace_verdict = verdict_lib.trace_status(
+        tracer.enabled, tracer.span_count, tracer.dropped,
+        exported=trace_summary is not None)
+    if tracer.enabled:
+        if trace_summary is not None:
+            dest = (trace_summary["merged_path"]
+                    or trace_summary["local_path"])
+            log0(f"tpudist: trace {trace_verdict}: "
+                 f"{trace_summary['spans']} spans from "
+                 f"{trace_summary['hosts']} host(s)"
+                 + (f", {trace_summary['dropped']} dropped"
+                    if trace_summary["dropped"] else "")
+                 + f" -> {dest}")
+        else:
+            log0(f"tpudist: trace {trace_verdict}: export failed "
+                 f"({trace_err!r})")
     metrics.log(kind="timing", steps_per_dispatch=k, **timer.split(),
                 **staging.split(), staging_overlap_fraction=overlap,
                 staging_status=staging_verdict,
-                tuning_status=tuning_status, **obs_fields)
+                tuning_status=tuning_status,
+                trace_status=trace_verdict,
+                trace_spans=(trace_summary or {}).get("spans"),
+                trace_dropped=(trace_summary or {}).get("dropped"),
+                **obs_fields)
     log0("Training completed.")  # parity banner (train.py:128)
     metrics.close()
     return last_avg
@@ -310,11 +382,12 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
         ∩ epoch, zero-padded to a k-multiple). Returns (arrays, bytes);
         bytes are PER-DEVICE, the unit the budget bounds."""
         t0 = time.perf_counter()
-        start = s * S
-        stop = min(n_steps, start + S)
-        pad_to = -(-(stop - start) // k) * k
-        host = plan.slab(start, stop, pad_to=pad_to)
-        arrs = shd.put_epoch(mesh, host)
+        with trace_lib.span("stage_slab", cat="staging", slab=s):
+            start = s * S
+            stop = min(n_steps, start + S)
+            pad_to = -(-(stop - start) // k) * k
+            host = plan.slab(start, stop, pad_to=pad_to)
+            arrs = shd.put_epoch(mesh, host)
         nbytes = pad_to * splan.step_bytes
         staging.note_staged(nbytes, time.perf_counter() - t0)
         return arrs, nbytes
@@ -348,7 +421,11 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
             hi = min(n_steps - gstart, k)
             slab = (cur if staged_len == k else
                     jax.tree.map(lambda a: a[j * k:(j + 1) * k], cur))
-            state, total, losses = superstep(state, total, slab, lo, hi)
+            # the ASYNC enqueue window; the matching device wall shows
+            # up in the "fence" spans (StepTimer.stop_many)
+            with trace_lib.span("dispatch", cat="dispatch"):
+                state, total, losses = superstep(state, total, slab, lo,
+                                                 hi)
             end = gstart + hi       # true global steps completed
             counted += hi - lo
             pending += hi - lo
@@ -409,6 +486,12 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
     last_avg = float("nan")
     staging = StagingStats() if staging is None else staging
     for epoch in range(start_epoch, cfg.epochs):
+        # one top-level span per epoch: staging/dispatch/fence/ckpt/eval
+        # child spans nest inside it, so the report's self-time pass
+        # attributes the epoch's remainder (python loop + async enqueue
+        # overhead) to the "train" phase
+        epoch_span = trace_lib.get().begin("epoch", cat="train",
+                                           epoch=epoch)
         plan = epoch_plan(epoch)
         n_steps = plan.n_steps
         # mid-epoch resume: the epoch's batch order is stateless by
@@ -434,11 +517,14 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             last_avg = _epoch_end(cfg, state, total, counted, pending,
                                   n_steps, epoch, metrics, timer, eval_fn,
                                   eval_batch, ckpt, observer=observer)
+            trace_lib.get().end(epoch_span)
             continue
-        batches = plan.slab(0, n_steps)
+        with trace_lib.span("stage_slab", cat="staging", slab=0):
+            batches = plan.slab(0, n_steps)
         for i in range(first, n_steps):
             batch = jax.tree.map(lambda a: a[i], batches)
-            state, loss = train_step(state, batch)
+            with trace_lib.span("dispatch", cat="dispatch"):
+                state, loss = train_step(state, batch)
             total = loss if total is None else total + loss
             counted += 1
             pending += 1
@@ -485,6 +571,7 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
         last_avg = _epoch_end(cfg, state, total, counted, pending, n_steps,
                               epoch, metrics, timer, eval_fn, eval_batch,
                               ckpt, observer=observer)
+        trace_lib.get().end(epoch_span)
 
     return last_avg
 
@@ -503,13 +590,15 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
     log0(f"Epoch {epoch + 1:2d} finished. Avg loss: {last_avg:.4f}")
     if observer is not None:
         observer.note_progress(phase="eval", epoch=epoch, step=n_steps)
-    eval_loss = float(eval_fn(state, eval_batch))
+    with trace_lib.span("eval", cat="eval", epoch=epoch):
+        eval_loss = float(eval_fn(state, eval_batch))
     log0(f"Epoch {epoch + 1:2d} eval loss: {eval_loss:.4f}")
     # per-host step-time aggregation (kind=hosts record + straggler
     # verdict): a collective — every process calls it, at a point where
     # all hosts are synchronized by construction (the epoch fence above)
     if observer is not None:
-        status = observer.epoch_end(epoch, timer, metrics)
+        with trace_lib.span("hosts_gather", cat="sync", epoch=epoch):
+            status = observer.epoch_end(epoch, timer, metrics)
         if status == verdict_lib.FAIL:
             worst = max(h["step_s_mean"] for h in observer.hosts.last_hosts
                         if h["steps"] > 0)

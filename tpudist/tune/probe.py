@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from tpudist import config as config_lib
 from tpudist import engine
+from tpudist.obs import trace as trace_lib
 from tpudist.parallel import sharding as shd
 
 # Probe length/repeats: long enough that per-epoch fixed costs (one
@@ -209,7 +210,10 @@ def probe_candidate(cfg, mesh, candidate, plan, *,
         # THIS candidate raised the watermark past the limit — otherwise
         # one big early trial would poison every later probe
         prior_peak = sampler.peak_in_use
-        _, times, compile_s = time_runner(runner, repeats=repeats)
+        with trace_lib.span("probe_trial", cat="tune", k=candidate.k,
+                            remat=candidate.remat,
+                            grad_accum=candidate.grad_accum_steps):
+            _, times, compile_s = time_runner(runner, repeats=repeats)
         sampler.sample()
         hbm = sampler.split()
         ms = min(times)   # one-sided noise: fastest epoch is cleanest

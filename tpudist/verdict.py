@@ -118,6 +118,36 @@ def tuning_status(mode: str, *, source: str = "heuristic",
     return UNGATEABLE if mode == "cache-only" else FAIL
 
 
+# A traced run whose ring buffers overwrote more than this fraction of
+# recorded spans has a timeline with holes — flagged, because the run
+# report's phase totals silently under-count exactly the longest runs.
+# Env override TPUDIST_TRACE_DROP_MAX (call time, like the other gates).
+TRACE_DROP_MAX = 0.5
+
+
+def trace_status(enabled: bool, spans: int, dropped: int,
+                 exported: bool, drop_max: float | None = None) -> str:
+    """Three-valued span-tracing verdict (tpudist.obs.trace) for the run
+    log + ``kind=timing`` record: UNGATEABLE with tracing off (nothing
+    recorded, nothing to certify); SUCCESS when the run-end export wrote
+    a trace and the ring buffers kept (most of) the timeline; FAIL when
+    tracing was ON but the export failed or overwrote more than the
+    drop threshold — the artifact the next debugging session will reach
+    for is missing or has holes. Advisory, like the staging/straggler
+    gates: a run that trains correctly with a broken tracer is an
+    observability finding, not a correctness failure."""
+    if not enabled:
+        return UNGATEABLE
+    if drop_max is None:
+        drop_max = _env_float("TPUDIST_TRACE_DROP_MAX", TRACE_DROP_MAX)
+    if not exported or spans <= 0:
+        return FAIL
+    total = spans + dropped
+    if total > 0 and dropped / total > drop_max:
+        return FAIL
+    return SUCCESS
+
+
 def _write(path: str, content: str) -> None:
     if path.startswith("gs://"):
         # shell-free: path/content go as argv/stdin, immune to metacharacters
