@@ -456,6 +456,30 @@ def run_tune_sweep(out_path: str, n_steps: int = 128,
     return art
 
 
+# --------------------------------------------------------- collective sweep
+
+
+def run_collective_sweep(out_path: str, kinds: str, min_mb: float,
+                         max_mb: float, iters: int) -> dict:
+    """Promote the collective sweep to a first-class artifact:
+    BENCH_COLLECTIVES.json (an original BASELINE.json north-star
+    artifact that never existed) — per-kind per-size bus GB/s and % of
+    ring peak, each axis labeled ICI vs DCN from the mesh, on the same
+    harness shape as the other BENCH_* files. ``tpudist.bench.sweep``
+    does the measuring (and stays the launcher's GATE); this wrapper
+    only shapes and writes the artifact, so the two never drift."""
+    from tpudist.bench import sweep as sweep_mod
+    records = sweep_mod.run_sweep(tuple(kinds.split(",")), "data",
+                                  min_mb=min_mb, max_mb=max_mb,
+                                  iters=iters)
+    if jax.process_index() == 0:
+        art = sweep_mod.write_collectives_artifact(records, out_path)
+    else:
+        art = sweep_mod.collectives_artifact(records)
+    print(json.dumps({k: art[k] for k in ("metric", "value", "unit")}))
+    return art
+
+
 # ------------------------------------------------------------------ matrix
 
 # (model, seq, head, flash, per_chip[, remat]) — meaningful cells only:
@@ -617,6 +641,20 @@ def main() -> None:
                         "steps/s, cache re-hit); write BENCH_TUNE.json")
     p.add_argument("--tune-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_TUNE.json"))
+    p.add_argument("--collective-sweep", action="store_true",
+                   help="sweep the collectives over the mesh's data "
+                        "axis (ICI/DCN-labeled) and write "
+                        "BENCH_COLLECTIVES.json — per-kind per-size bus "
+                        "GB/s + %% of ring peak")
+    p.add_argument("--collective-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_COLLECTIVES.json"))
+    p.add_argument("--collective-kinds", type=str,
+                   default="all_reduce,all_gather,reduce_scatter,"
+                           "all_to_all,ppermute")
+    p.add_argument("--collective-min-mb", type=float, default=1)
+    p.add_argument("--collective-max-mb", type=float, default=1024)
+    p.add_argument("--collective-iters", type=int, default=10)
     p.add_argument("--cell", type=str, default=None,
                    help="internal: run one matrix cell "
                         "(model:seq:head:flash:per_chip:remat)")
@@ -639,6 +677,12 @@ def main() -> None:
         return
     if args.tune_sweep:
         run_tune_sweep(args.tune_out)
+        return
+    if args.collective_sweep:
+        run_collective_sweep(args.collective_out, args.collective_kinds,
+                             args.collective_min_mb,
+                             args.collective_max_mb,
+                             args.collective_iters)
         return
     if args.matrix:
         run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)

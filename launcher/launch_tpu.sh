@@ -285,6 +285,13 @@ gcloud compute tpus tpu-vm scp \
   "$TPU_NAME:$OBS_DIR/run_report.md" \
   flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
   --worker=0 2>/dev/null || true
+# --profile-window device captures (raw jax.profiler trace-event JSON
+# under $OBS_DIR/profile/worker<i>): pull the coordinator's so the
+# devtime split can be re-derived offline (tpudist.obs.devtime is
+# jax-free). Best-effort — the dir only exists on windowed runs.
+gcloud compute tpus tpu-vm scp --recurse "$TPU_NAME:$OBS_DIR/profile" \
+  flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
+  --worker=0 2>/dev/null || true
 ls -l flightrec_artifacts/ 2>/dev/null || true
 
 # ---- gated bandwidth sweep (while the slice is alive) ----------------------
@@ -302,9 +309,15 @@ if [ "${RUN_SWEEP:-0}" = "1" ]; then
   tpu_ssh all "timeout 900 $RUN_PREFIX python3 -m tpudist.bench.sweep \
     --kinds all_reduce,all_gather,reduce_scatter,all_to_all,ppermute \
     --min-pct-peak $SWEEP_MIN_PCT $SWEEP_PEAK_ARG \
-    --out /tmp/sweep.jsonl"
+    --out /tmp/sweep.jsonl --bench-out /tmp/BENCH_COLLECTIVES.json"
   SWEEP_RC=$?
   gcloud compute tpus tpu-vm scp "$TPU_NAME:/tmp/sweep.jsonl" sweep.jsonl \
+    --zone "$ZONE" --project "$PROJECT" --worker=0 || true
+  # the first-class artifact (per-kind per-size GB/s + % ring peak,
+  # ICI/DCN-labeled): same rows, BENCH_* harness shape — the report
+  # CLI consumes it via --collectives
+  gcloud compute tpus tpu-vm scp "$TPU_NAME:/tmp/BENCH_COLLECTIVES.json" \
+    BENCH_COLLECTIVES.json \
     --zone "$ZONE" --project "$PROJECT" --worker=0 || true
   set -e
   if [ $SWEEP_RC -eq 3 ]; then

@@ -1,0 +1,662 @@
+"""Device-time attribution (tpudist.obs.devtime): the jax-free capture
+parser, the exposed-communication interval math, the --profile-window
+capture mode end to end, the report's "Device time" section and
+comm_status gate, and the BENCH_COLLECTIVES artifact plumbing."""
+
+import gzip
+import json
+import subprocess
+import sys
+import types
+
+import numpy as np
+import pytest
+
+from tpudist import config as config_lib
+from tpudist import train as train_mod
+from tpudist.config import TrainConfig
+from tpudist.obs import devtime
+from tpudist.obs import report as report_mod
+
+
+# ------------------------------------------------------- classification
+
+
+class TestClassify:
+    @pytest.mark.parametrize("name", [
+        "fusion.123", "dot.0", "copy.155", "multiply_add_fusion.8",
+        "reduce.0", "dynamic-slice_bitcast_fusion", "convert.7",
+    ])
+    def test_compute_ops(self, name):
+        assert devtime.classify(name) == "compute"
+
+    @pytest.mark.parametrize("name", [
+        "all-reduce.1", "all-gather.0", "all-to-all.2", "reduce-scatter",
+        "collective-permute.0", "all-reduce-start", "all-reduce-done",
+        "send.1", "recv-done.3", "add_all-reduce_fusion",
+        "MegascaleTransfer.0", "ncclAllReduce",
+    ])
+    def test_comm_ops(self, name):
+        assert devtime.classify(name) == "comm"
+
+    @pytest.mark.parametrize("name", [
+        "ThunkExecutor::Execute", "ThreadpoolListener::StartRegion",
+        "$builtins isinstance", "$contextlib.py:130 __enter__",
+        "D2D Dispatch", "TfrtCpuExecutable::ExecuteHelper", "", "42?",
+    ])
+    def test_runtime_noise_is_neither(self, name):
+        assert devtime.classify(name) is None
+
+
+# -------------------------------------------------------- interval math
+
+
+class TestIntervals:
+    def test_merge_union(self):
+        assert devtime.merge_intervals(
+            [(5, 7), (0, 2), (1, 3), (7, 7), (6, 9)]) == [(0, 3), (5, 9)]
+        assert devtime.merge_intervals([]) == []
+
+    def test_subtract_cases(self):
+        sub = devtime.subtract_intervals
+        assert sub([(0, 10)], [(2, 4), (6, 8)]) == [(0, 2), (4, 6),
+                                                    (8, 10)]
+        assert sub([(0, 10)], [(0, 10)]) == []          # fully covered
+        assert sub([(0, 10)], []) == [(0, 10)]          # nothing to cut
+        assert sub([(2, 4)], [(0, 10)]) == []           # nested in b
+        assert sub([(0, 4), (6, 10)], [(3, 7)]) == [(0, 3), (7, 10)]
+
+    def test_intersect_cases(self):
+        inter = devtime.intersect_intervals
+        assert inter([(0, 10)], [(2, 4), (8, 12)]) == [(2, 4), (8, 10)]
+        assert inter([(0, 2)], [(2, 4)]) == []          # touching only
+
+    def test_partition_property(self):
+        """subtract and intersect partition a exactly: |a\\b| + |a∩b|
+        == |a| for scripted interval families."""
+        fams = [
+            ([(0, 10), (20, 30)], [(5, 12), (12, 14), (25, 30)]),
+            ([(0, 100)], [(i, i + 1) for i in range(0, 100, 3)]),
+            ([(i, i + 2) for i in range(0, 50, 5)], [(1, 49)]),
+            ([], [(0, 5)]),
+        ]
+        for a, b in fams:
+            tot = devtime.measure(devtime.merge_intervals(a))
+            cut = devtime.measure(devtime.subtract_intervals(a, b))
+            hit = devtime.measure(devtime.intersect_intervals(a, b))
+            assert cut + hit == pytest.approx(tot, abs=1e-12)
+
+
+# ---------------------------------------------------------- attribution
+
+
+class TestAttribute:
+    def test_overlap_edge_cases_exact(self):
+        """Nested (fully hidden), back-to-back (partially exposed) and
+        lone (fully exposed) comm — the exact answers."""
+        ops = [(0.0, 10.0, "fusion.1"), (20.0, 30.0, "dot.2"),
+               (5.0, 12.0, "all-reduce.0"), (12.0, 14.0, "all-gather.0"),
+               (25.0, 30.0, "all-reduce.1"),
+               (40.0, 45.0, "collective-permute.0")]
+        d = devtime.attribute_tracks({"dev0": ops})["devices"]["dev0"]
+        assert d["exposed_comm_s"] * 1e6 == pytest.approx(9.0)
+        assert d["compute_s"] * 1e6 == pytest.approx(20.0)
+        assert d["comm_s"] * 1e6 == pytest.approx(19.0)
+        assert d["idle_s"] * 1e6 == pytest.approx(16.0)
+        assert (d["compute_frac"] + d["exposed_comm_frac"]
+                + d["idle_frac"]) == pytest.approx(1.0)
+
+    def test_fully_hidden_comm_is_zero_exposed(self):
+        ops = [(0.0, 100.0, "fusion.1"), (10.0, 90.0, "all-reduce.0")]
+        d = devtime.attribute_tracks({"d": ops})["devices"]["d"]
+        assert d["exposed_comm_s"] == 0.0
+        assert d["comm_s"] * 1e6 == pytest.approx(80.0)
+
+    def test_comm_only_track_fully_exposed(self):
+        ops = [(0.0, 50.0, "all-reduce.0")]
+        d = devtime.attribute_tracks({"d": ops})["devices"]["d"]
+        assert d["exposed_comm_s"] * 1e6 == pytest.approx(50.0)
+        assert d["exposed_comm_frac"] == pytest.approx(1.0)
+        assert d["idle_frac"] == 0.0
+
+    def test_shared_window_marks_straggler_idle(self):
+        """The idle window is capture-wide: a device idling while its
+        peer computes reads as idle, not as a shorter window."""
+        out = devtime.attribute_tracks({
+            "d0": [(0.0, 100.0, "fusion.1")],
+            "d1": [(0.0, 10.0, "fusion.2")],
+        })
+        assert out["devices"]["d1"]["window_s"] == \
+            out["devices"]["d0"]["window_s"]
+        assert out["devices"]["d1"]["idle_frac"] == pytest.approx(0.9)
+        assert out["pod"]["devices"] == 2
+
+    def test_empty_tracks(self):
+        out = devtime.attribute_tracks({})
+        assert out["devices"] == {} and out["pod"]["devices"] == 0
+        assert out["pod"]["exposed_comm_frac"] is None
+
+
+# ------------------------------------------------------ capture parsing
+
+
+def _meta(pid, name, tid=None, tname=None):
+    if tid is None:
+        return {"ph": "M", "name": "process_name", "pid": pid,
+                "args": {"name": name}}
+    return {"ph": "M", "name": "thread_name", "pid": pid, "tid": tid,
+            "args": {"name": tname}}
+
+
+def _x(pid, tid, name, ts, dur):
+    return {"ph": "X", "pid": pid, "tid": tid, "name": name, "ts": ts,
+            "dur": dur}
+
+
+def _cpu_doc():
+    """The CPU backend's capture shape: one /host:CPU process, HLO ops
+    on the PJRT client pool threads, python/runtime noise elsewhere."""
+    return {"traceEvents": [
+        _meta(701, "/host:CPU"),
+        _meta(701, None, tid=1, tname="python"),
+        _meta(701, None, tid=2, tname="tf_XLATfrtCpuClient/-216782909"),
+        _meta(701, None, tid=3, tname="tf_XLATfrtCpuClient/12345"),
+        _x(701, 1, "$builtins isinstance", 0.0, 500.0),
+        _x(701, 2, "dot.3", 10.0, 5.0),
+        _x(701, 2, "ThunkExecutor::Execute", 9.0, 20.0),
+        _x(701, 3, "all-reduce.1", 14.0, 6.0),
+        _x(701, 2, "D2D Dispatch", 16.0, 1.0),
+    ]}
+
+
+def _tpu_doc():
+    """The TPU shape: one process per device, ops on the "XLA Ops"
+    thread; "Steps"/"XLA Modules" threads must not double-count."""
+    return {"traceEvents": [
+        _meta(1, "/device:TPU:0"),
+        _meta(1, None, tid=1, tname="XLA Ops"),
+        _meta(1, None, tid=2, tname="Steps"),
+        _meta(1, None, tid=3, tname="XLA Modules"),
+        _meta(2, "/device:TPU:1"),
+        _meta(2, None, tid=1, tname="XLA Ops"),
+        _meta(9, "/host:CPU"),
+        _meta(9, None, tid=1, tname="python"),
+        _x(1, 1, "fusion.7", 0.0, 10.0),
+        _x(1, 1, "all-reduce.2", 8.0, 6.0),
+        _x(1, 2, "17", 0.0, 100.0),             # a step-number event
+        _x(1, 3, "jit_superstep", 0.0, 100.0),  # whole-module window
+        _x(2, 1, "fusion.7", 2.0, 10.0),
+        _x(9, 1, "$something", 0.0, 50.0),
+    ]}
+
+
+class TestCaptureParse:
+    def test_cpu_shape_one_synthetic_track(self):
+        tracks = devtime.device_op_tracks(_cpu_doc())
+        assert list(tracks) == ["host:CPU"]
+        names = sorted(op for _, _, op in tracks["host:CPU"])
+        assert names == ["all-reduce.1", "dot.3"]
+
+    def test_tpu_shape_one_track_per_device(self):
+        tracks = devtime.device_op_tracks(_tpu_doc())
+        assert sorted(tracks) == ["TPU:0", "TPU:1"]
+        assert [op for _, _, op in tracks["TPU:0"]] == ["fusion.7",
+                                                        "all-reduce.2"]
+        # the Steps / XLA Modules events did not leak into the track
+        assert all(t1 - t0 <= 10.0 for t0, t1, _ in tracks["TPU:0"])
+
+    def test_gz_roundtrip_and_analyze(self, tmp_path):
+        d = tmp_path / "plugins" / "profile" / "2026_01_01"
+        d.mkdir(parents=True)
+        with gzip.open(d / "host.trace.json.gz", "wt") as f:
+            json.dump(_tpu_doc(), f)
+        assert devtime.find_captures(str(tmp_path)) == [
+            str(d / "host.trace.json.gz")]
+        out = devtime.analyze_capture(str(tmp_path))
+        assert sorted(out["devices"]) == ["TPU:0", "TPU:1"]
+        # TPU:0 exposed = all-reduce [8,14] minus fusion [0,10] = 4 µs
+        assert out["devices"]["TPU:0"]["exposed_comm_s"] * 1e6 == \
+            pytest.approx(4.0)
+
+    def test_missing_capture_raises(self, tmp_path):
+        with pytest.raises(FileNotFoundError):
+            devtime.analyze_capture(str(tmp_path))
+
+
+# ------------------------------------------------- config + resolvers
+
+
+class TestProfileWindowConfig:
+    def test_default_off_env_and_flag(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_PROFILE_WINDOW", raising=False)
+        assert config_lib.resolve_profile_window(TrainConfig()) == 0
+        assert config_lib.resolve_profile_window(
+            TrainConfig(profile_window=3)) == 3
+        monkeypatch.setenv("TPUDIST_PROFILE_WINDOW", "2")
+        assert config_lib.resolve_profile_window(TrainConfig()) == 2
+        # explicit flag beats env
+        assert config_lib.resolve_profile_window(
+            TrainConfig(profile_window=5)) == 5
+
+    def test_full_run_profile_dir_wins(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_PROFILE_WINDOW", "2")
+        cfg = TrainConfig(profile_window=4, profile_dir="/tmp/p")
+        assert config_lib.resolve_profile_window(cfg) == 0
+
+    def test_negative_raises(self):
+        with pytest.raises(ValueError):
+            config_lib.resolve_profile_window(
+                TrainConfig(profile_window=-1))
+
+    def test_cli_flag_parses(self):
+        cfg = config_lib.parse_args(["--profile-window", "3"])
+        assert cfg.profile_window == 3
+
+    def test_window_composes_with_autotune_probe(self):
+        """THE coupling fix: the windowed capture must not disable the
+        autotuner (only full-run --profile-dir does)."""
+        cfg = TrainConfig(profile_window=2, autotune="probe")
+        assert config_lib.resolve_autotune(cfg) == "probe"
+
+    def test_full_run_profiling_still_forces_autotune_off(self):
+        cfg = TrainConfig(profile_dir="/tmp/p", autotune="probe")
+        assert config_lib.resolve_autotune(cfg) == "off"
+
+    def test_window_keeps_superstep_dispatch(self):
+        """--profile-window captures SUPERSTEPS: auto-k must stay >1
+        (unlike --profile-dir, which forces per-step dispatch)."""
+        cfg = TrainConfig(profile_window=2, log_every=4)
+        assert config_lib.resolve_steps_per_dispatch(cfg) == 4
+        cfg = TrainConfig(profile_dir="/tmp/p", log_every=4)
+        assert config_lib.resolve_steps_per_dispatch(cfg) == 1
+
+
+class TestCommStatus:
+    def test_thresholds(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_COMM_EXPOSED_MAX", raising=False)
+        assert devtime.comm_status(None) == "ungateable"
+        assert devtime.comm_status(0.0) == "success"
+        assert devtime.comm_status(0.25) == "success"   # inclusive
+        assert devtime.comm_status(0.26) == "fail"
+
+    def test_env_override_at_call_time(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "0.05")
+        assert devtime.comm_status(0.1) == "fail"
+        monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "0.5")
+        assert devtime.comm_status(0.1) == "success"
+        monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "bogus")
+        assert devtime.comm_status(0.1) == "success"    # default 0.25
+
+    def test_verdict_delegator_matches(self):
+        from tpudist import verdict as verdict_lib
+        assert verdict_lib.comm_status(0.9) == devtime.comm_status(0.9)
+
+
+# --------------------------------------------- report: Device time
+
+
+S = 1e6     # seconds -> µs
+
+
+def _devtime_fixture():
+    """Host spans + merged device track: compute [4,5.5]s, comm
+    [5,6.5]s -> exposed [5.5,6.5] = 1s, of which [5.5,6]s sits under
+    the dispatch fence and [6,6.5]s under the bare epoch (train)."""
+    host = [
+        {"name": "epoch", "cat": "train", "ph": "X", "ts": 0.0,
+         "dur": 10 * S, "pid": 0, "tid": 0},
+        {"name": "stage_slab", "cat": "staging", "ph": "X", "ts": 1 * S,
+         "dur": 1 * S, "pid": 0, "tid": 0},
+        {"name": "fence", "cat": "dispatch", "ph": "X", "ts": 4 * S,
+         "dur": 2 * S, "pid": 0, "tid": 0},
+    ]
+    dev = [
+        {"ph": "M", "name": "thread_name", "pid": 0, "tid": 1000,
+         "args": {"name": "device:TPU:0"}},
+        {"name": "compute", "cat": "devtime", "ph": "X", "ts": 4.0 * S,
+         "dur": 1.5 * S, "pid": 0, "tid": 1000,
+         "args": {"device": "TPU:0"}},
+        {"name": "comm", "cat": "devtime", "ph": "X", "ts": 5.0 * S,
+         "dur": 1.5 * S, "pid": 0, "tid": 1000,
+         "args": {"device": "TPU:0"}},
+    ]
+    metrics = [{"kind": "timing", "steps": 100, "run_s": 10.0,
+                "compile_warmup_s": 1.0}]
+    return metrics, {"traceEvents": host + dev,
+                     "metadata": {"hosts": 1, "dropped": 0}}
+
+
+class TestReportDevtime:
+    def test_split_and_phase_attribution(self, monkeypatch):
+        monkeypatch.delenv("TPUDIST_COMM_EXPOSED_MAX", raising=False)
+        metrics, doc = _devtime_fixture()
+        rep = report_mod.build_report(metrics, doc)
+        dt = rep["devtime"]
+        d = dt["devices"]["host0/TPU:0"]
+        assert d["compute_s"] == pytest.approx(1.5)
+        assert d["comm_s"] == pytest.approx(1.5)
+        assert d["exposed_comm_s"] == pytest.approx(1.0)
+        # window [4, 6.5]: busy everywhere -> idle 0; fracs sum to 1
+        assert d["idle_frac"] == pytest.approx(0.0)
+        assert (d["compute_frac"] + d["exposed_comm_frac"]
+                + d["idle_frac"]) == pytest.approx(1.0)
+        # per-phase attribution: 0.5s under the fence, 0.5s bare epoch
+        assert dt["exposed_by_phase"]["dispatch"] == pytest.approx(0.5)
+        assert dt["exposed_by_phase"]["train"] == pytest.approx(0.5)
+        # 1.0/2.5 = 40% exposed: over the default 25% gate
+        assert dt["comm_status"] == "fail"
+        assert rep["run"]["comm_status"] == "fail"
+        # ... but advisory, like staging: the report verdict holds
+        assert rep["verdict"] == "success"
+
+    def test_pod_window_counts_wall_once_per_host(self):
+        """Two device tracks on one host: pod.window_s is the capture
+        window (not 2x), and the exposed fraction divides by
+        device-seconds — the kind=devtime record's convention, so
+        report and metrics agree."""
+        metrics, doc = _devtime_fixture()
+        second = [dict(e, tid=1001,
+                       args={"device": "TPU:1"})
+                  for e in doc["traceEvents"]
+                  if e.get("cat") == "devtime"]
+        doc["traceEvents"].extend(second)
+        rep = report_mod.build_report(metrics, doc)
+        pod = rep["devtime"]["pod"]
+        assert pod["devices"] == 2
+        assert pod["window_s"] == pytest.approx(2.5)       # not 5.0
+        assert pod["exposed_comm_s"] == pytest.approx(2.0)  # summed
+        # 2.0 exposed over 2 × 2.5 device-seconds = 0.4
+        assert pod["exposed_comm_frac"] == pytest.approx(0.4)
+
+    def test_device_events_do_not_pollute_host_phases(self):
+        metrics, doc = _devtime_fixture()
+        rep = report_mod.build_report(metrics, doc)
+        assert "devtime" not in rep["hosts"]["0"]["phases"]
+        assert rep["hosts"]["0"]["coverage"] == pytest.approx(1.0)
+
+    def test_comm_gate_env_and_baseline_delta(self, monkeypatch):
+        monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "0.5")
+        metrics, doc = _devtime_fixture()
+        rep = report_mod.build_report(
+            metrics, doc,
+            baseline={"devtime": {"pod": {"exposed_comm_frac": 0.3}}})
+        dt = rep["devtime"]
+        assert dt["comm_status"] == "success"    # 40% <= 50%
+        assert dt["baseline_exposed_comm_frac"] == pytest.approx(0.3)
+        assert dt["exposed_comm_frac_delta"] == pytest.approx(0.1)
+
+    def test_no_capture_is_ungateable(self):
+        metrics = [{"kind": "timing", "steps": 1, "run_s": 1.0}]
+        doc = {"traceEvents": [
+            {"name": "epoch", "cat": "train", "ph": "X", "ts": 0.0,
+             "dur": 1 * S, "pid": 0, "tid": 0}]}
+        rep = report_mod.build_report(metrics, doc)
+        assert rep["devtime"]["comm_status"] == "ungateable"
+        assert rep["run"]["comm_status"] == "ungateable"
+
+    def test_fallback_to_devtime_record(self):
+        """--trace off runs still get a Device time section from the
+        kind=devtime metrics record."""
+        metrics = [{"kind": "devtime", "comm_status": "success",
+                    "process_index": 0, "window_s": 2.0,
+                    "compute_s": 1.5, "comm_s": 0.5,
+                    "exposed_comm_s": 0.1, "devices": 1,
+                    "exposed_comm_frac": 0.05,
+                    "per_device": [{"device": "TPU:0", "window_s": 2.0,
+                                    "compute_s": 1.5, "comm_s": 0.5,
+                                    "exposed_comm_s": 0.1}]}]
+        doc = {"traceEvents": []}
+        rep = report_mod.build_report(metrics, doc)
+        dt = rep["devtime"]
+        assert dt["pod"]["exposed_comm_frac"] == pytest.approx(0.05)
+        assert dt["comm_status"] == "success"
+        assert "host0/TPU:0" in dt["devices"]
+
+    def test_markdown_renders_device_time(self):
+        metrics, doc = _devtime_fixture()
+        md = report_mod.to_markdown(report_mod.build_report(metrics, doc))
+        assert "## Device time" in md
+        assert "host0/TPU:0" in md
+        assert "exposed comm by host phase" in md
+
+
+# ------------------------------------------------- collectives artifact
+
+
+def _collectives_doc():
+    rows = [
+        {"kind": "all_reduce", "n_devices": 4, "axis": "data",
+         "fabric": "ici", "message_bytes": 1 << 20, "bus_gbps": 10.0,
+         "pct_of_ring_peak": 50.0},
+        {"kind": "all_reduce", "n_devices": 4, "axis": "data",
+         "fabric": "ici", "message_bytes": 4 << 20, "bus_gbps": 40.0,
+         "pct_of_ring_peak": 80.0},
+        {"kind": "all_gather", "n_devices": 4, "axis": "data",
+         "fabric": "ici", "message_bytes": 1 << 20, "bus_gbps": 30.0,
+         "pct_of_ring_peak": 60.0},
+    ]
+    return {"metric": "collective_all_reduce_best_bus_gbps",
+            "value": 40.0, "unit": "GB/s",
+            "detail": {"device": "cpu", "n_devices": 4, "axis": "data",
+                       "fabric": "ici", "rows": rows}}
+
+
+class TestCollectives:
+    def test_section_best_per_kind(self):
+        sec = report_mod.collectives_section(_collectives_doc())
+        assert sec["per_kind"]["all_reduce"]["bus_gbps"] == 40.0
+        assert sec["per_kind"]["all_reduce"]["message_bytes"] == 4 << 20
+        assert sec["per_kind"]["all_gather"]["pct_of_ring_peak"] == 60.0
+        assert sec["fabric"] == "ici" and sec["rows"] == 3
+
+    def test_section_none_when_absent(self):
+        assert report_mod.collectives_section(None) is None
+
+    def test_axis_fabric_from_slice_indices(self):
+        def dev(slice_index):
+            return types.SimpleNamespace(slice_index=slice_index)
+        from tpudist.bench import sweep as sweep_mod
+        ici = types.SimpleNamespace(
+            devices=np.array([[dev(0), dev(0)], [dev(0), dev(0)]],
+                             dtype=object),
+            axis_names=("data", "model"))
+        assert sweep_mod.axis_fabric(ici, "data") == "ici"
+        dcn = types.SimpleNamespace(
+            devices=np.array([[dev(0), dev(0)], [dev(1), dev(1)]],
+                             dtype=object),
+            axis_names=("data", "model"))
+        assert sweep_mod.axis_fabric(dcn, "data") == "dcn"
+        # the other axis of the same mesh stays intra-slice
+        assert sweep_mod.axis_fabric(dcn, "model") == "ici"
+
+    def test_artifact_shape_from_live_sweep(self):
+        """One tiny bucket on the 8-device CPU mesh through the real
+        measuring path: the artifact has the BENCH_* harness shape and
+        ICI labels (virtual CPU devices have no slices)."""
+        from tpudist.bench import sweep as sweep_mod
+        records = sweep_mod.run_sweep(("all_reduce",), "data",
+                                      min_mb=0.25, max_mb=0.25, iters=2)
+        art = sweep_mod.collectives_artifact(records)
+        assert art["metric"] == "collective_all_reduce_best_bus_gbps"
+        assert art["value"] > 0
+        assert art["detail"]["fabric"] == "ici"
+        assert art["detail"]["axis"] == "data"
+        assert art["detail"]["rows"][0]["n_devices"] == 8
+
+    def test_artifact_headline_names_the_measured_kind(self):
+        """A sweep without all_reduce must not label another kind's
+        bandwidth as all_reduce."""
+        from tpudist.bench import sweep as sweep_mod
+        rows = [{"kind": "all_gather", "n_devices": 4, "axis": "data",
+                 "fabric": "ici", "message_bytes": 1 << 20,
+                 "bus_gbps": 7.0, "pct_of_ring_peak": None}]
+        art = sweep_mod.collectives_artifact(rows)
+        assert art["metric"] == "collective_all_gather_best_bus_gbps"
+        assert art["value"] == 7.0
+
+    def test_report_cli_consumes_without_jax(self, tmp_path):
+        """ACCEPTANCE PIN: the report CLI ingests BENCH_COLLECTIVES.json
+        with jax UNIMPORTABLE — the offline path must run on a laptop
+        with no accelerator stack installed."""
+        (tmp_path / "metrics.jsonl").write_text(json.dumps(
+            {"kind": "timing", "steps": 10, "run_s": 1.0}) + "\n")
+        metrics, doc = _devtime_fixture()
+        (tmp_path / "pod_trace.json").write_text(json.dumps(doc))
+        (tmp_path / "BENCH_COLLECTIVES.json").write_text(
+            json.dumps(_collectives_doc()))
+        script = (
+            "import sys; sys.modules['jax'] = None\n"
+            "from tpudist.obs import report\n"
+            f"rc = report.main(['--run-dir', {str(tmp_path)!r}])\n"
+            "assert rc == 0, rc\n"
+            f"rep = __import__('json').load(open({str(tmp_path)!r}"
+            " + '/run_report.json'))\n"
+            "assert rep['collectives']['per_kind']['all_reduce']"
+            "['bus_gbps'] == 40.0\n"
+            "assert rep['devtime']['comm_status'], rep['devtime']\n"
+            "print('jax-free report OK')\n")
+        r = subprocess.run([sys.executable, "-c", script],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        assert "jax-free report OK" in r.stdout
+
+
+# ----------------------------------------- the windowed train CLI e2e
+
+
+@pytest.fixture(scope="module")
+def windowed_run(tmp_path_factory):
+    """One --profile-window train run on the virtual CPU mesh shared by
+    the acceptance assertions below."""
+    save = tmp_path_factory.mktemp("windowed_run")
+    rc = train_mod.main(["--epochs", "2", "--train-batch-size", "64",
+                         "--n-samples", "512", "--log-every", "4",
+                         "--profile-window", "2",
+                         "--save-dir", str(save)])
+    assert rc == 0
+    return save
+
+
+def test_windowed_run_devtime_record(windowed_run):
+    """ACCEPTANCE PIN: the kind=devtime record exists and its
+    compute+comm+idle fractions sum to 1 ± 0.01 per device."""
+    recs = [json.loads(ln) for ln in open(windowed_run / "metrics.jsonl")]
+    dev = [r for r in recs if r["kind"] == "devtime"]
+    assert len(dev) == 1
+    d = dev[0]
+    assert d["comm_status"] in ("success", "fail")
+    assert d["dispatches"] == 2
+    assert d["per_device"]
+    for pd in d["per_device"]:
+        assert pd["compute_s"] >= 0 and pd["comm_s"] >= 0
+        assert pd["exposed_comm_s"] <= pd["comm_s"] + 1e-9
+        total = (pd["compute_frac"] + pd["exposed_comm_frac"]
+                 + pd["idle_frac"])
+        assert total == pytest.approx(1.0, abs=0.01)
+    # the capture itself landed under <save>/profile/worker0
+    assert devtime.find_captures(str(windowed_run / "profile"))
+    # and the timing record carries the same verdict
+    t = [r for r in recs if r["kind"] == "timing"][0]
+    assert t["comm_status"] == d["comm_status"]
+
+
+def test_windowed_run_device_tracks_in_pod_trace(windowed_run):
+    """ACCEPTANCE PIN: >= 1 device track per host under the host's row
+    in pod_trace.json."""
+    doc = json.load(open(windowed_run / "pod_trace.json"))
+    dev_evs = [e for e in doc["traceEvents"]
+               if e.get("cat") == "devtime"]
+    assert dev_evs and {e["pid"] for e in dev_evs} == {0}
+    tracks = [e for e in doc["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "thread_name"
+              and str((e.get("args") or {}).get("name", "")
+                      ).startswith("device:")]
+    assert len(tracks) >= 1
+    assert doc["metadata"]["device_tracks"] >= 1
+    # the device events sit on their own synthetic tids, clear of the
+    # host span threads
+    assert all(e["tid"] >= devtime.DEVICE_TID_BASE for e in dev_evs)
+
+
+def test_windowed_run_report_section(windowed_run):
+    """ACCEPTANCE PIN: the run report grows a Device time section with
+    a non-null comm_status."""
+    rc = report_mod.main(["--run-dir", str(windowed_run)])
+    assert rc == 0
+    rep = json.load(open(windowed_run / "run_report.json"))
+    dt = rep["devtime"]
+    assert dt["comm_status"] in ("success", "fail")
+    assert rep["run"]["comm_status"] == dt["comm_status"]
+    assert dt["devices"] and dt["pod"]["window_s"] > 0
+    assert "## Device time" in (windowed_run / "run_report.md"
+                                ).read_text()
+    # host-phase analysis is unpolluted: coverage still >= 0.9
+    assert rep["hosts"]["0"]["coverage"] >= 0.9
+
+
+def test_window_off_is_bitwise_identical_and_artifact_free(
+        windowed_run, tmp_path):
+    """ACCEPTANCE PIN: the same run with the window off is
+    bitwise-identical in step losses and emits no devtime artifact."""
+    save = tmp_path / "nowin"
+    rc = train_mod.main(["--epochs", "2", "--train-batch-size", "64",
+                         "--n-samples", "512", "--log-every", "4",
+                         "--save-dir", str(save)])
+    assert rc == 0
+
+    def step_losses(p):
+        return [(r["step"], r["loss"]) for r in
+                (json.loads(ln) for ln in open(p / "metrics.jsonl"))
+                if r["kind"] == "step"]
+    assert step_losses(save) == step_losses(windowed_run)
+    recs = [json.loads(ln) for ln in open(save / "metrics.jsonl")]
+    assert not [r for r in recs if r["kind"] == "devtime"]
+    assert not (save / "profile").exists()
+    doc = json.load(open(save / "pod_trace.json"))
+    assert not [e for e in doc["traceEvents"]
+                if e.get("cat") == "devtime"]
+    t = [r for r in recs if r["kind"] == "timing"][0]
+    assert t["comm_status"] == "ungateable"
+
+
+# --------------------------------------------- stall-path integration
+
+
+def test_stall_stops_open_capture_and_flightrec_names_it(tmp_path):
+    """Satellite: the watchdog firing during an open capture window
+    stops the profiler and keeps the partial capture next to the
+    flight record (a hung run still yields a device timeline)."""
+    import time
+
+    from tpudist.metrics import MetricsLogger
+    from tpudist.obs import FlightRecorder
+
+    win = devtime.WindowProfiler(str(tmp_path / "profile"), 100,
+                                 process_index=0, trigger_epoch=0)
+    win.maybe_start(0)
+    assert win.state == "open"
+    metrics = MetricsLogger(path=None)
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=0.3,
+                         metrics=metrics, stall_hook=win.emergency_stop)
+    try:
+        rec.note_progress(phase="train", epoch=0, step=1)
+        deadline = time.monotonic() + 10.0
+        while rec.dumps < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert rec.dumps >= 1
+    finally:
+        rec.close()
+        metrics.close()
+        win.close()
+    assert win.state == "done" and win.captured
+    art = json.load(open(rec.flightrec_path))
+    assert art["extra"]["profile_capture"] == win.capture_dir
+    # the partial capture is parseable by the same ingest path
+    assert devtime.find_captures(win.capture_dir)
+    devtime.analyze_capture(win.capture_dir)
+
+
+def test_emergency_stop_without_window_is_none(tmp_path):
+    win = devtime.WindowProfiler(str(tmp_path), 2)
+    assert win.emergency_stop() is None        # never opened
+    assert win.state == "armed"

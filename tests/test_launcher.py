@@ -166,18 +166,23 @@ def test_flight_records_collected_on_workload_failure(stub_env):
 
 def test_success_collects_trace_report_not_flight_records(stub_env):
     """On success the launcher pulls the coordinator's merged pod trace
-    + offline run report (worker 0 only), and does NOT run the
-    all-worker recursive flight-record scrape (that is the failure
-    path's job)."""
+    + offline run report + --profile-window device captures (worker 0
+    only), and does NOT run the all-worker recursive flight-record
+    scrape (that is the failure path's job)."""
     env, stub = stub_env
     r = launch(env)
     assert r.returncode == 0
     calls = (stub / "calls.log").read_text().splitlines()
     assert not [ln for ln in calls
-                if "scp" in ln and "--recurse" in ln]
+                if "scp" in ln and "--recurse" in ln
+                and "--worker=all" in ln]
     report_pulls = [ln for ln in calls
                     if "scp" in ln and "pod_trace.json" in ln]
     assert report_pulls and "--worker=0" in report_pulls[0]
+    # the device-capture pull is coordinator-only too
+    profile_pulls = [ln for ln in calls
+                     if "scp" in ln and "tpudist_obs/profile" in ln]
+    assert profile_pulls and "--worker=0" in profile_pulls[0]
     assert any("tpudist.obs.report" in ln for ln in calls)
     # the workload itself runs with traces landed in OBS_DIR
     train = [ln for ln in calls if "tpudist.train" in ln][0]

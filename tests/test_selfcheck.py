@@ -38,5 +38,6 @@ def test_check_registry_covers_both_kernels_and_both_models():
     # long-context schedule and GQA), a train smoke per model family, and
     # the forced-stall flight-recorder drill (CI's observability gate)
     for needle in ("fused_xent", "flash_attention", "long_context", "gqa",
-                   "train_step", "moe", "flight_recorder", "autotune"):
+                   "train_step", "moe", "flight_recorder", "autotune",
+                   "devtime"):
         assert needle in joined, f"selfcheck lane lost its {needle} check"

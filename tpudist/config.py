@@ -100,6 +100,12 @@ class TrainConfig:
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
+    profile_window: int = 0       # capture N mid-run supersteps with
+    # jax.profiler into <trace-dir>/profile/worker<i> and ingest the
+    # device timeline at run end (obs.devtime: kind=devtime record,
+    # device tracks in pod_trace.json, comm_status). 0 = off
+    # ($TPUDIST_PROFILE_WINDOW). Unlike --profile-dir this is cheap,
+    # keeps superstep dispatch, and composes with --autotune probe
     steps_per_dispatch: int = 0   # superstep length k: one compiled
     # lax.scan dispatch covers k train steps (engine.make_superstep).
     # 0 = auto (resolve_steps_per_dispatch); 1 = per-step dispatch.
@@ -255,9 +261,13 @@ def resolve_autotune(cfg: TrainConfig) -> str:
 
     ``probe`` measures on a cache miss; ``cache-only`` reuses a prior
     measurement but never probes (pod launches where N workers probing
-    at startup is unwanted). Fault injection and profiling force
-    ``off``: both are defined in per-step-dispatch terms, so every knob
-    the tuner searches is already pinned.
+    at startup is unwanted). Fault injection and FULL-RUN profiling
+    (``--profile-dir``) force ``off``: both are defined in
+    per-step-dispatch terms, so every knob the tuner searches is
+    already pinned. The windowed capture (``--profile-window``) does
+    NOT force off — it profiles whatever operating point the run
+    actually uses, tuned or not, and runs long after the probes are
+    done (pinned in tests/test_devtime.py).
     """
     mode = cfg.autotune
     if mode is None:
@@ -268,6 +278,26 @@ def resolve_autotune(cfg: TrainConfig) -> str:
     if mode != "off" and (cfg.fail_at is not None or cfg.profile_dir):
         return "off"
     return mode
+
+
+def resolve_profile_window(cfg: TrainConfig) -> int:
+    """Resolve ``--profile-window`` / ``TPUDIST_PROFILE_WINDOW`` to the
+    number of mid-run supersteps to capture (0 = off).
+
+    Precedence: explicit flag > env > 0. Full-run ``--profile-dir``
+    wins over the window (profiler sessions cannot nest — the whole
+    run is already inside one), so the window resolves to 0 there.
+    """
+    n = cfg.profile_window
+    if n < 0:
+        raise ValueError(
+            f"--profile-window must be >= 0, got {n}")
+    if n == 0:
+        env = _env_float("TPUDIST_PROFILE_WINDOW")
+        n = int(env) if env and env > 0 else 0
+    if cfg.profile_dir:
+        return 0
+    return n
 
 
 def resolve_autotune_cache_dir(cfg: TrainConfig) -> str:
@@ -517,6 +547,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                         "profile/worker<i> subdirs, so multi-host "
                         "traces are complete; the reference had no "
                         "profiling at all (SURVEY.md §5.1)")
+    p.add_argument("--profile-window", type=int, default=0,
+                   help="capture N mid-run supersteps with jax.profiler "
+                        "on every worker (profile/worker<i> under "
+                        "--trace-dir) and ingest the device timeline at "
+                        "run end: kind=devtime record, device tracks in "
+                        "pod_trace.json, comm_status verdict (default: "
+                        "$TPUDIST_PROFILE_WINDOW, else 0 = off; "
+                        "--profile-dir wins when both are set)")
     p.add_argument("--trace", type=str, default=None,
                    choices=list(TRACE_MODES),
                    help="host-side span tracing (obs.trace): on by "
@@ -551,6 +589,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         fail_at=args.fail_at,
         log_every=args.log_every,
         profile_dir=args.profile_dir,
+        profile_window=args.profile_window,
         steps_per_dispatch=args.steps_per_dispatch,
         compilation_cache_dir=args.compilation_cache_dir,
         staging_budget_mb=args.staging_budget_mb,

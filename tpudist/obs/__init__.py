@@ -14,6 +14,9 @@ of a timeout (DESIGN.md "Observability"):
     own cost analysis;
   * :mod:`trace` — host-side span tracer (ring buffers, Chrome
     trace-event export, pod-merged Perfetto timeline);
+  * :mod:`devtime` — device-time attribution: the jax-free parser for
+    ``jax.profiler`` captures (compute vs exposed-communication split)
+    plus the ``--profile-window`` capture mode;
   * :mod:`report` — the offline run-report CLI over the merged trace
     plus ``metrics.jsonl`` (``python -m tpudist.obs.report``).
 
@@ -25,15 +28,16 @@ from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-from tpudist.obs import flightrec, hbm, heartbeat, hoststats, mfu, trace
+from tpudist.obs import (devtime, flightrec, hbm, heartbeat, hoststats,
+                         mfu, trace)
 from tpudist.obs.flightrec import dump_flight_record
 from tpudist.obs.hbm import HbmSampler
 from tpudist.obs.heartbeat import FlightRecorder
 from tpudist.obs.hoststats import HostStepStats
 
 __all__ = ["FlightRecorder", "HbmSampler", "HostStepStats", "PodObserver",
-           "dump_flight_record", "flightrec", "hbm", "heartbeat",
-           "hoststats", "mfu", "trace"]
+           "devtime", "dump_flight_record", "flightrec", "hbm",
+           "heartbeat", "hoststats", "mfu", "trace"]
 
 
 class PodObserver:
@@ -48,7 +52,8 @@ class PodObserver:
 
     def __init__(self, *, out_dir: str, stall_timeout_s: float = 300.0,
                  hbm_sample_s: float = 2.0, metrics: Any = None,
-                 process_index: int = 0, process_count: int = 1):
+                 process_index: int = 0, process_count: int = 1,
+                 stall_hook: Any = None):
         self.hbm = (HbmSampler(period_s=hbm_sample_s)
                     if hbm_sample_s > 0 else None)
         self.hosts = HostStepStats(process_index=process_index,
@@ -57,18 +62,19 @@ class PodObserver:
             out_dir, stall_timeout_s=stall_timeout_s,
             process_index=process_index, metrics=metrics,
             extra_state=(self.hbm.split if self.hbm else None),
-            tracer=trace.get())
+            tracer=trace.get(), stall_hook=stall_hook)
         self._closed = False
 
     @classmethod
     def from_config(cls, cfg, *, metrics=None, process_index: int = 0,
-                    process_count: int = 1) -> "PodObserver":
+                    process_count: int = 1,
+                    stall_hook: Any = None) -> "PodObserver":
         from tpudist.config import resolve_obs
         stall_s, out_dir, hbm_s = resolve_obs(cfg)
         return cls(out_dir=out_dir, stall_timeout_s=stall_s,
                    hbm_sample_s=hbm_s, metrics=metrics,
                    process_index=process_index,
-                   process_count=process_count)
+                   process_count=process_count, stall_hook=stall_hook)
 
     def note_progress(self, **kv: Any) -> None:
         self.recorder.note_progress(**kv)

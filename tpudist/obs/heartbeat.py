@@ -61,13 +61,21 @@ class FlightRecorder:
         the run hung) and exports the worker's local Chrome trace next
         to the flight record — a hung run leaves its TIMELINE, not
         just its stacks.
+      * ``stall_hook`` — optional callable fired at dump time, BEFORE
+        the record is written; returns a path (or None) recorded as
+        ``profile_capture`` in the flight record. The windowed device
+        profiler (obs.devtime.WindowProfiler.emergency_stop) hangs off
+        this: a run that stalls with a capture window open still stops
+        the profiler cleanly and keeps the partial device timeline
+        next to the flight record.
     """
 
     def __init__(self, out_dir: str, *, stall_timeout_s: float = 300.0,
                  process_index: int = 0, metrics: Any = None,
                  extra_state: Optional[Callable[[], Dict]] = None,
                  tracer: Any = None, last_n_metrics: int = 50,
-                 last_n_spans: int = 64):
+                 last_n_spans: int = 64,
+                 stall_hook: Optional[Callable[[], Optional[str]]] = None):
         if stall_timeout_s < 0:
             raise ValueError(
                 f"stall_timeout_s must be >= 0, got {stall_timeout_s}")
@@ -77,6 +85,7 @@ class FlightRecorder:
         self.metrics = metrics
         self.extra_state = extra_state
         self.tracer = tracer
+        self.stall_hook = stall_hook
         self.last_n_metrics = last_n_metrics
         self.last_n_spans = last_n_spans
         self.beacon_path = os.path.join(
@@ -163,6 +172,17 @@ class FlightRecorder:
                 extra = self.extra_state()
             except Exception:
                 extra = None
+        if self.stall_hook is not None:
+            # e.g. stop an open device-profiler window so the partial
+            # capture survives next to this record (a hung run still
+            # yields a device timeline); fired before the write so the
+            # record can name the capture path
+            try:
+                capture = self.stall_hook()
+            except Exception:
+                capture = None
+            if capture:
+                extra = {**(extra or {}), "profile_capture": capture}
         spans = None
         if self.tracer is not None and getattr(self.tracer, "enabled",
                                                False):

@@ -13,13 +13,23 @@ with:
   * exposed-vs-overlapped staging time (``slab_wait`` spans = H2D the
     pipeline failed to hide; ``stage_slab`` = host staging work that
     overlapped compute);
+  * DEVICE time (``--profile-window`` runs): the compute vs
+    exposed-communication split recomputed from the device tracks
+    merged into ``pod_trace.json`` (obs.devtime), exposed comm
+    attributed to the host phase it occurred under, the
+    ``comm_status`` verdict (``TPUDIST_COMM_EXPOSED_MAX``), and the
+    delta against a baseline's exposed-comm fraction;
   * straggler attribution BY PHASE: not just "host 3 was slow" but
     which phase put it behind the pod median;
   * checkpoint-drain stalls (enqueue vs drain blocked time);
-  * a regression verdict against a baseline steps/s.
+  * a regression verdict against a baseline steps/s;
+  * the collective-sweep artifact (``--collectives
+    BENCH_COLLECTIVES.json``): per-kind best bus bandwidth and % of
+    ring peak, folded into the same report.
 
 Offline by design: no jax import, no device touch — it runs on a
-laptop against scp'd artifacts from a dead pod.
+laptop against scp'd artifacts from a dead pod (obs.devtime, the only
+tpudist import here, is jax-free for the same reason).
 """
 
 from __future__ import annotations
@@ -30,7 +40,9 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
-REPORT_SCHEMA_VERSION = 1
+from tpudist.obs import devtime as devtime_mod
+
+REPORT_SCHEMA_VERSION = 2
 
 SUCCESS = "success"
 FAIL = "fail"
@@ -212,6 +224,196 @@ def ckpt_section(events, metrics) -> Dict[str, Any]:
     }
 
 
+# Exposed-comm phase attribution: host span categories in priority
+# order — the most specific wins (a fence is inside an epoch; exposed
+# comm during it is a DISPATCH finding, not a "train" finding). The
+# "profile" cat (the capture-window bracket span itself) is excluded:
+# it covers the whole window by construction and would absorb
+# everything.
+PHASE_PRIORITY = ("dispatch", "staging", "ckpt", "eval", "tune", "sync",
+                  "data", "init", "train")
+
+
+def _exposed_by_phase(exposed, host_evs) -> Dict[str, float]:
+    """Attribute exposed-comm intervals (µs, merged) to the host phase
+    they occurred under; leftovers (no span open, or only the capture
+    bracket) read as ``other``."""
+    by_cat: Dict[str, list] = {}
+    for e in host_evs:
+        cat = e.get("cat", "misc")
+        if cat == "profile":
+            continue
+        ts, dur = float(e["ts"]), float(e["dur"])
+        by_cat.setdefault(cat, []).append((ts, ts + dur))
+    remaining = devtime_mod.merge_intervals(exposed)
+    out: Dict[str, float] = {}
+    extras = sorted(set(by_cat) - set(PHASE_PRIORITY))
+    for cat in list(PHASE_PRIORITY) + extras:
+        if cat not in by_cat or not remaining:
+            continue
+        hit = devtime_mod.intersect_intervals(remaining, by_cat[cat])
+        s = devtime_mod.measure(hit) / 1e6
+        if s > 0:
+            out[cat] = round(s, 6)
+        remaining = devtime_mod.subtract_intervals(remaining,
+                                                   by_cat[cat])
+    left = devtime_mod.measure(remaining) / 1e6
+    if left > 0:
+        out["other"] = round(left, 6)
+    return out
+
+
+def devtime_section(events, metrics, baseline: Optional[Dict]
+                    ) -> Dict[str, Any]:
+    """The device-time split: compute vs exposed communication per
+    device track, recomputed from the device events a
+    ``--profile-window`` run merged into ``pod_trace.json``
+    (obs.devtime's interval math — the same operator the live run
+    used), plus the per-phase attribution of exposed comm against the
+    host spans, the ``comm_status`` verdict, and the exposed-fraction
+    delta vs baseline. Falls back to the ``kind=devtime`` metrics
+    record when the trace carries no device tracks (e.g. a ``--trace
+    off`` run); ungateable when neither exists."""
+    dev_evs = [e for e in events
+               if e.get("cat") == devtime_mod.DEVTIME_CAT]
+    host_evs = [e for e in events
+                if e.get("cat") != devtime_mod.DEVTIME_CAT]
+    recs = [r for r in metrics if r.get("kind") == "devtime"]
+
+    devices: Dict[str, Any] = {}
+    exposed_by_phase: Dict[str, float] = {}
+    pod = {"compute_s": 0.0, "comm_s": 0.0, "exposed_comm_s": 0.0,
+           "window_s": 0.0, "devices": 0, "exposed_comm_frac": None}
+    if dev_evs:
+        # per host: rebuild each device track's class intervals from
+        # the coalesced compute/comm events
+        by_pid: Dict[int, Dict[str, Dict[str, list]]] = {}
+        for e in dev_evs:
+            pid = e.get("pid", 0)
+            dev = (e.get("args") or {}).get("device", str(e.get("tid")))
+            cls = e.get("name")
+            if cls not in ("compute", "comm"):
+                continue
+            ts, dur = float(e["ts"]), float(e["dur"])
+            by_pid.setdefault(pid, {}).setdefault(
+                dev, {"compute": [], "comm": []})[cls].append(
+                    (ts, ts + dur))
+        # window_s counts wall once per HOST (the capture window), while
+        # the exposed fraction divides by DEVICE-seconds (window × each
+        # host's device count) — the same convention as the live
+        # kind=devtime record (devtime.attribute_tracks), so the report
+        # and metrics.jsonl agree on both numbers
+        win_host_us = 0.0
+        win_dev_us = 0.0
+        for pid, tracks in sorted(by_pid.items()):
+            allv = [iv for c in tracks.values()
+                    for ivs in c.values() for iv in ivs]
+            window = (min(lo for lo, _ in allv),
+                      max(hi for _, hi in allv)) if allv else None
+            if window is not None:
+                win_host_us += window[1] - window[0]
+            exposed_pid: list = []
+            for dev, classed in sorted(tracks.items()):
+                att = devtime_mod.attribute_classed(classed, window)
+                devices[f"host{pid}/{dev}"] = {
+                    k: (round(v, 6) if isinstance(v, float) else v)
+                    for k, v in att.items()}
+                for k in ("compute_s", "comm_s", "exposed_comm_s"):
+                    pod[k] += att[k]
+                pod["devices"] += 1
+                win_dev_us += att["window_s"] * 1e6
+                exposed_pid.extend(devtime_mod.subtract_intervals(
+                    classed["comm"], classed["compute"]))
+            host_pid_evs = [e for e in host_evs if e.get("pid") == pid]
+            for cat, s in _exposed_by_phase(exposed_pid,
+                                            host_pid_evs).items():
+                exposed_by_phase[cat] = round(
+                    exposed_by_phase.get(cat, 0.0) + s, 6)
+        pod["window_s"] = round(win_host_us / 1e6, 6)
+        pod["exposed_comm_frac"] = (
+            round(pod["exposed_comm_s"] * 1e6 / win_dev_us, 6)
+            if win_dev_us > 0 else None)
+        for k in ("compute_s", "comm_s", "exposed_comm_s"):
+            pod[k] = round(pod[k], 6)
+    elif recs:
+        rec = recs[-1]
+        for d in rec.get("per_device", []):
+            devices[f"host{rec.get('process_index', 0)}/"
+                    f"{d.get('device')}"] = {
+                k: v for k, v in d.items() if k != "device"}
+        for k in ("compute_s", "comm_s", "exposed_comm_s", "window_s",
+                  "devices", "exposed_comm_frac"):
+            if rec.get(k) is not None:
+                pod[k] = rec[k]
+
+    status = devtime_mod.comm_status(pod["exposed_comm_frac"])
+    base_frac = _find_exposed_frac(baseline) if baseline else None
+    delta = (round(pod["exposed_comm_frac"] - base_frac, 6)
+             if (pod["exposed_comm_frac"] is not None
+                 and base_frac is not None) else None)
+    return {
+        "comm_status": status,
+        "devices": devices,
+        "pod": pod,
+        "exposed_by_phase": exposed_by_phase,
+        "record_comm_status": (recs[-1].get("comm_status")
+                               if recs else None),
+        "baseline_exposed_comm_frac": base_frac,
+        "exposed_comm_frac_delta": delta,
+    }
+
+
+def _find_exposed_frac(doc: Any) -> Optional[float]:
+    """Dig an exposed-comm fraction out of a baseline document: a prior
+    run_report (``devtime.pod.exposed_comm_frac``) or a bare pin."""
+    if not isinstance(doc, dict):
+        return None
+    for path in (("exposed_comm_frac",),
+                 ("devtime", "pod", "exposed_comm_frac")):
+        cur: Any = doc
+        for k in path:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, (int, float)):
+            return float(cur)
+    return None
+
+
+def collectives_section(doc: Optional[Dict]) -> Optional[Dict[str, Any]]:
+    """Fold BENCH_COLLECTIVES.json (bench.py --collective-sweep) into
+    the report: per collective kind, the best-bucket bus bandwidth and
+    % of ring peak. Purely informational — the sweep gate already ran
+    live; this puts the numbers next to the exposed-comm split they
+    explain."""
+    if not doc:
+        return None
+    detail = doc.get("detail", doc)
+    rows = detail.get("rows", [])
+    per_kind: Dict[str, Dict[str, Any]] = {}
+    for r in rows:
+        # tolerate truncated/hand-kept artifacts (this CLI's offline
+        # contract): a row without a kind or bandwidth is skipped, not
+        # a traceback
+        kind = r.get("kind")
+        gbps = r.get("bus_gbps")
+        if kind is None or not isinstance(gbps, (int, float)):
+            continue
+        best = per_kind.get(kind)
+        if best is None or gbps > best["bus_gbps"]:
+            per_kind[kind] = {
+                "bus_gbps": gbps,
+                "pct_of_ring_peak": r.get("pct_of_ring_peak"),
+                "message_bytes": r.get("message_bytes"),
+                "fabric": r.get("fabric"),
+            }
+    return {
+        "axis": detail.get("axis"),
+        "fabric": detail.get("fabric"),
+        "n_devices": detail.get("n_devices"),
+        "rows": len(rows),
+        "per_kind": per_kind,
+    }
+
+
 def straggler_section(hosts: Dict[int, Dict[str, Any]],
                       metrics) -> Dict[str, Any]:
     """Straggler attribution BY PHASE: for each host, which phase's
@@ -285,14 +487,20 @@ def _find_steps_per_sec(doc: Any) -> Optional[float]:
 def build_report(metrics: List[Dict[str, Any]],
                  trace_doc: Dict[str, Any], *,
                  baseline: Optional[Dict] = None,
-                 regress_min: Optional[float] = None) -> Dict[str, Any]:
+                 regress_min: Optional[float] = None,
+                 collectives: Optional[Dict] = None) -> Dict[str, Any]:
     if regress_min is None:
         try:
             regress_min = float(os.environ.get(
                 "TPUDIST_REGRESS_MIN", REGRESS_MIN_FRACTION))
         except ValueError:
             regress_min = REGRESS_MIN_FRACTION
-    events = complete_events(trace_doc)
+    all_events = complete_events(trace_doc)
+    # the host-side analyses must not see the device tracks: a device
+    # busy interval is not a host phase, and folding it into self-time
+    # would double every covered second of a profiled window
+    events = [e for e in all_events
+              if e.get("cat") != devtime_mod.DEVTIME_CAT]
     hosts = self_times(events)
     timings = [r for r in metrics if r.get("kind") == "timing"]
     timing = timings[-1] if timings else None
@@ -301,6 +509,7 @@ def build_report(metrics: List[Dict[str, Any]],
 
     regression = regression_section(timing, baseline, regress_min)
     stragglers = straggler_section(hosts, metrics)
+    devtime = devtime_section(all_events, metrics, baseline)
     # pod-level phase totals (sum over hosts)
     pod_phases: Dict[str, float] = {}
     for h in hosts.values():
@@ -329,6 +538,7 @@ def build_report(metrics: List[Dict[str, Any]],
             "tuning_status": (tunes[-1].get("status") if tunes
                               else (timing or {}).get("tuning_status")),
             "straggler_status": stragglers["status"],
+            "comm_status": devtime["comm_status"],
             "trace_status": (timing.get("trace_status")
                              if timing else None),
         },
@@ -344,6 +554,8 @@ def build_report(metrics: List[Dict[str, Any]],
                        sorted(pod_phases.items(), key=lambda kv: -kv[1])},
         "staging": staging_section(events, timing),
         "ckpt": ckpt_section(events, metrics),
+        "devtime": devtime,
+        "collectives": collectives_section(collectives),
         "stragglers": stragglers,
         "regression": regression,
         "verdict": verdict,
@@ -398,6 +610,49 @@ def to_markdown(report: Dict[str, Any]) -> str:
               f"- {ck['saves']} saves, enqueue {ck['enqueue_s']:.3f}s, "
               f"drain {ck['drain_s']:.3f}s over {ck['drain_spans']} "
               f"drain windows (worst {ck['worst_drain_s']:.3f}s)", ""]
+    dt = r.get("devtime") or {}
+    if dt.get("devices"):
+        pod = dt["pod"]
+        lines += ["## Device time (compute vs exposed communication)",
+                  "",
+                  f"**comm_status: {dt['comm_status']}** — exposed "
+                  f"comm {pod['exposed_comm_s']:.3f}s summed over "
+                  f"{pod['devices']} device track(s), "
+                  f"{100 * (pod['exposed_comm_frac'] or 0):.1f}% of "
+                  f"device time in a {pod['window_s']:.3f}s window"
+                  + (f", baseline "
+                     f"{100 * dt['baseline_exposed_comm_frac']:.1f}% "
+                     f"(delta "
+                     f"{100 * dt['exposed_comm_frac_delta']:+.1f}pp)"
+                     if dt.get("exposed_comm_frac_delta") is not None
+                     else ""), "",
+                  "| device | compute s | comm s | exposed s | idle % |",
+                  "|---|---|---|---|---|"]
+        for name, d in dt["devices"].items():
+            idle = d.get("idle_frac")
+            lines.append(
+                f"| {name} | {d['compute_s']:.3f} | {d['comm_s']:.3f} "
+                f"| {d['exposed_comm_s']:.3f} | "
+                + (f"{100 * idle:.1f} |" if idle is not None else "— |"))
+        lines.append("")
+        if dt.get("exposed_by_phase"):
+            lines.append("- exposed comm by host phase: " + ", ".join(
+                f"{cat} {s:.3f}s"
+                for cat, s in dt["exposed_by_phase"].items()))
+            lines.append("")
+    co = r.get("collectives")
+    if co and co.get("per_kind"):
+        lines += ["## Collectives (bench sweep)", "",
+                  "| kind | fabric | best bus GB/s | % ring peak | "
+                  "at bytes |", "|---|---|---|---|---|"]
+        for kind, k in sorted(co["per_kind"].items()):
+            pct = k.get("pct_of_ring_peak")
+            lines.append(
+                f"| {kind} | {k.get('fabric') or co.get('fabric') or '—'}"
+                f" | {k.get('bus_gbps'):.2f} | "
+                + (f"{pct:.1f}" if pct is not None else "—")
+                + f" | {k.get('message_bytes')} |")
+        lines.append("")
     if r["stragglers"]["attribution"]:
         lines += ["## Straggler attribution", ""]
         for a in r["stragglers"]["attribution"]:
@@ -430,7 +685,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "json) path")
     p.add_argument("--baseline", type=str, default=None,
                    help="baseline JSON carrying steps_per_sec (e.g. a "
-                        "prior run_report.json) for the regression gate")
+                        "prior run_report.json) for the regression gate "
+                        "— a prior report also baselines the exposed-"
+                        "comm fraction for the devtime delta")
+    p.add_argument("--collectives", type=str, default=None,
+                   help="BENCH_COLLECTIVES.json (bench.py "
+                        "--collective-sweep) folded into the report's "
+                        "Collectives section (default: <run-dir>/"
+                        "BENCH_COLLECTIVES.json when present)")
     p.add_argument("--regress-min", type=float, default=None,
                    help=f"regression floor as a fraction of baseline "
                         f"steps/s (default $TPUDIST_REGRESS_MIN, else "
@@ -465,9 +727,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.baseline:
         with open(args.baseline) as f:
             baseline = json.load(f)
+    collectives = None
+    coll_path = args.collectives or os.path.join(run_dir,
+                                                 "BENCH_COLLECTIVES.json")
+    if os.path.exists(coll_path):
+        with open(coll_path) as f:
+            collectives = json.load(f)
+    elif args.collectives:
+        print(f"tpudist.obs.report: missing collectives file "
+              f"{coll_path}", file=sys.stderr)
+        return 2
 
     report = build_report(metrics, trace_doc, baseline=baseline,
-                          regress_min=args.regress_min)
+                          regress_min=args.regress_min,
+                          collectives=collectives)
     out_json = args.out_json or os.path.join(run_dir, "run_report.json")
     out_md = args.out_md or os.path.join(run_dir, "run_report.md")
     for path, payload in ((out_json, json.dumps(report, indent=1)),

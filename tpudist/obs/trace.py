@@ -412,7 +412,7 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
     feed it scripted offsets."""
     events: List[Dict[str, Any]] = []
     clock_sync = {}
-    spans = dropped = 0
+    spans = dropped = device_tracks = 0
     for i, doc in enumerate(worker_docs):
         off_us = offsets_ns[i] / 1e3 if i < len(offsets_ns) else 0.0
         for ev in doc.get("traceEvents", []):
@@ -424,6 +424,7 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
         meta = doc.get("metadata", {})
         spans += int(meta.get("spans", 0))
         dropped += int(meta.get("dropped", 0))
+        device_tracks += int(meta.get("device_tracks", 0))
         clock_sync[str(i)] = meta.get("clock_sync")
     events.sort(key=lambda e: (e.get("ts", -1.0)))
     return {
@@ -436,17 +437,26 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
             "clock_sync": clock_sync,
             "spans": spans,
             "dropped": dropped,
+            "device_tracks": device_tracks,
         },
     }
 
 
 def export_pod_trace(out_dir: str, *, process_index: int = 0,
                      process_count: int = 1,
-                     tracer: Optional[Tracer] = None
+                     tracer: Optional[Tracer] = None,
+                     extra_events: Optional[List[Dict[str, Any]]] = None
                      ) -> Dict[str, Any]:
     """Run-end export: write this worker's ``trace.worker<i>.json``,
     probe clock offsets, gather every worker's spans, and (coordinator
     only) write the merged ``pod_trace.json``.
+
+    ``extra_events`` are pre-built Chrome events appended to this
+    worker's document before the gather — the device-timeline tracks
+    from a ``--profile-window`` capture (obs.devtime) ride the same
+    gather/merge/clock-shift path as the host spans, so they land
+    under this host's row in ``pod_trace.json``. Their timestamps must
+    already be on this host's monotonic (``perf_counter``) timebase.
 
     CONTAINS COLLECTIVES on multi-host runs — call it only at a point
     every process reaches (the success path after the epoch loop; a
@@ -459,6 +469,10 @@ def export_pod_trace(out_dir: str, *, process_index: int = 0,
     # building it twice would walk/sort the rings twice and let spans
     # recorded in between make the two copies disagree
     doc = tracer.to_doc(process_index=process_index)
+    if extra_events:
+        doc["traceEvents"].extend(extra_events)
+        doc["metadata"]["device_tracks"] = sum(
+            1 for e in extra_events if e.get("ph") == "M")
     _atomic_write_json(local_path, doc)
     tracer.exported = True
     offsets = estimate_clock_offsets(process_count)

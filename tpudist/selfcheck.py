@@ -387,6 +387,53 @@ def check_autotune():
           f"regression floor held at k={out2.best.k}", flush=True)
 
 
+def check_devtime():
+    """The device-time attribution math on a SCRIPTED trace fixture
+    (pure interval arithmetic — no device work, identical on every
+    backend): known compute/comm intervals must yield the EXACT
+    exposed-communication answer through every overlap edge case —
+    comm nested inside compute (fully hidden), back-to-back comm
+    windows whose union partially escapes compute, a lone comm burst
+    with no compute at all (fully exposed) — and the
+    compute/exposed/idle fractions must decompose the window exactly."""
+    from tpudist.obs import devtime
+
+    # classification: the names XLA actually emits
+    assert devtime.classify("fusion.123") == "compute"
+    assert devtime.classify("all-reduce.3") == "comm"
+    assert devtime.classify("all-gather-start") == "comm"
+    assert devtime.classify("ThunkExecutor::Execute") is None
+    assert devtime.classify("$builtins isinstance") is None
+
+    # scripted track (times in µs):
+    #   compute  [0,10] [20,30]
+    #   comm     [5,12]+[12,14] back-to-back -> exposed [10,14] = 4
+    #            [25,30] nested in compute    -> fully hidden, 0
+    #            [40,45] no compute anywhere  -> fully exposed, 5
+    ops = [(0.0, 10.0, "fusion.1"), (20.0, 30.0, "dot.2"),
+           (5.0, 12.0, "all-reduce.0"), (12.0, 14.0, "all-gather.0"),
+           (25.0, 30.0, "all-reduce.1"),
+           (40.0, 45.0, "collective-permute.0")]
+    out = devtime.attribute_tracks({"dev0": ops})
+    d = out["devices"]["dev0"]
+    assert abs(d["exposed_comm_s"] * 1e6 - 9.0) < 1e-9, d
+    assert abs(d["compute_s"] * 1e6 - 20.0) < 1e-9, d
+    assert abs(d["comm_s"] * 1e6 - 19.0) < 1e-9, d
+    # window [0,45]: busy = [0,14]+[20,30]+[40,45] = 29 -> idle 16
+    assert abs(d["idle_s"] * 1e6 - 16.0) < 1e-9, d
+    s = d["compute_frac"] + d["exposed_comm_frac"] + d["idle_frac"]
+    assert abs(s - 1.0) < 1e-9, s
+    # the verdict: 9/45 = 20% exposed clears the default 25% gate but
+    # not a 10% one; no measurement is ungateable, not a pass
+    assert devtime.comm_status(d["exposed_comm_frac"]) == "success"
+    assert devtime.comm_status(d["exposed_comm_frac"], 0.10) == "fail"
+    assert devtime.comm_status(None) == "ungateable"
+    print(f"  devtime drill: exposed {d['exposed_comm_s'] * 1e6:.0f} µs "
+          f"of {d['comm_s'] * 1e6:.0f} µs comm "
+          f"({100 * d['exposed_comm_frac']:.1f}% of the window)",
+          flush=True)
+
+
 def check_flight_recorder():
     """The flight-recorder pipeline end-to-end with a DELIBERATELY
     wedged step: progress beacons flow while steps advance, then the
@@ -467,6 +514,7 @@ def check_moe_smoke():
 
 CHECKS = [
     check_autotune,
+    check_devtime,
     check_fused_xent,
     check_fused_xent_bench_geometry,
     check_flash_attention,

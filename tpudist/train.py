@@ -36,6 +36,7 @@ from tpudist import config as config_lib
 from tpudist.config import TrainConfig, parse_args
 from tpudist.metrics import (MetricsLogger, StagingStats, StepTimer,
                              device_kind, log0)
+from tpudist.obs import devtime as devtime_lib
 from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
 
@@ -191,12 +192,21 @@ def run(cfg: TrainConfig) -> float:
     timer = StepTimer()
     last_avg = float("nan")
 
+    # windowed device capture (--profile-window): N mid-run supersteps
+    # of jax.profiler timeline per worker, ingested at run end into the
+    # compute/exposed-comm split (obs.devtime). None when off.
+    win = devtime_lib.WindowProfiler.from_config(
+        cfg, out_dir=trace_dir, process_index=ctx.process_index)
+
     # the flight recorder: heartbeat beacon + stall watchdog + HBM
     # watermark sampler + per-host straggler tracking — a hung or slow
-    # pod run leaves a diagnosis (flightrec.worker<i>), not a timeout
+    # pod run leaves a diagnosis (flightrec.worker<i>), not a timeout.
+    # The stall hook stops an open capture window so even a hung run
+    # keeps its (partial) device timeline next to the flight record.
     observer = obs_lib.PodObserver.from_config(
         cfg, metrics=metrics, process_index=ctx.process_index,
-        process_count=ctx.process_count)
+        process_count=ctx.process_count,
+        stall_hook=(win.emergency_stop if win is not None else None))
 
     # one manager for the whole run: async saves overlap the next epoch's
     # steps (the old save-per-call shape implied a synchronous drain)
@@ -222,9 +232,12 @@ def run(cfg: TrainConfig) -> float:
                                    eval_fn, eval_batch, ckpt,
                                    superstep=superstep, k=k,
                                    budget_bytes=budget_bytes,
-                                   staging=staging, observer=observer)
+                                   staging=staging, observer=observer,
+                                   profiler_win=win)
         run_ok = True
     finally:
+        if win is not None:
+            win.close()   # a window wider than the run still stops clean
         observer.note_progress(phase="shutdown")
         ckpt.close()   # drain outstanding async writes before exiting
         # the async-checkpoint cost the per-save enqueue_ms cannot see:
@@ -285,18 +298,55 @@ def run(cfg: TrainConfig) -> float:
              f" MB ({obs_fields['hbm_source']})"
              + (f", {100 * obs_fields['hbm_peak_fraction']:.1f}% of device"
                 if obs_fields.get("hbm_peak_fraction") else ""))
+    # devtime ingest: parse this worker's --profile-window capture into
+    # the compute / exposed-communication split (obs.devtime) — the
+    # kind=devtime record, the comm_status verdict, and the device
+    # tracks that ride the pod-trace gather below. Advisory end to end:
+    # a malformed capture logs a line, never fails the run.
+    devtime_status = verdict_lib.UNGATEABLE
+    dev_events = None
+    if win is not None and win.captured:
+        try:
+            with trace_lib.span("devtime_ingest", cat="profile"):
+                analysis = devtime_lib.analyze_capture(win.capture_dir)
+            pod = analysis["pod"]
+            devtime_status = verdict_lib.comm_status(
+                pod["exposed_comm_frac"])
+            dev_events = devtime_lib.device_events(
+                analysis, process_index=ctx.process_index,
+                anchor_us=(win.anchor_ns or 0) / 1e3)
+            metrics.log(
+                kind="devtime", comm_status=devtime_status,
+                capture=win.capture_dir, dispatches=win.seen,
+                process_index=ctx.process_index, **pod,
+                per_device=[{"device": name, **d}
+                            for name, d in analysis["devices"].items()])
+            log0(f"tpudist: devtime {devtime_status}: "
+                 f"compute {pod['compute_s']:.3f}s, comm "
+                 f"{pod['comm_s']:.3f}s ({pod['exposed_comm_s']:.3f}s "
+                 f"exposed, "
+                 f"{100 * (pod['exposed_comm_frac'] or 0):.1f}% of the "
+                 f"{pod['window_s']:.3f}s window) over "
+                 f"{pod['devices']} device track(s)")
+        except Exception as e:
+            devtime_status = verdict_lib.FAIL
+            log0(f"tpudist: devtime fail: capture ingest failed ({e!r})")
+
     # run-end span export: every worker writes trace.worker<i>.json,
     # clock offsets come from a barrier-bracketed allgather probe, and
     # the coordinator merges one Perfetto track per host into
-    # pod_trace.json. A COLLECTIVE — but this is the success path, all
-    # hosts reach it (a dying run took the local-only export above).
+    # pod_trace.json (device tracks from the capture window, when one
+    # ran, land under each host's row). A COLLECTIVE — but this is the
+    # success path, all hosts reach it (a dying run took the local-only
+    # export above).
     trace_summary = None
     trace_err = None
     if tracer.enabled:
         try:
             trace_summary = trace_lib.export_pod_trace(
                 trace_dir, process_index=ctx.process_index,
-                process_count=ctx.process_count, tracer=tracer)
+                process_count=ctx.process_count, tracer=tracer,
+                extra_events=dev_events)
         except Exception as e:   # observability must never fail the run
             trace_err = e
     trace_verdict = verdict_lib.trace_status(
@@ -319,6 +369,7 @@ def run(cfg: TrainConfig) -> float:
                 **staging.split(), staging_overlap_fraction=overlap,
                 staging_status=staging_verdict,
                 tuning_status=tuning_status,
+                comm_status=devtime_status,
                 trace_status=trace_verdict,
                 trace_spans=(trace_summary or {}).get("spans"),
                 trace_dropped=(trace_summary or {}).get("dropped"),
@@ -330,7 +381,7 @@ def run(cfg: TrainConfig) -> float:
 
 def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                      n_steps, epoch, metrics, timer, ckpt, budget_bytes,
-                     staging, observer=None):
+                     staging, observer=None, profiler_win=None):
     """One epoch under superstep dispatch with bounded-memory staging.
 
     ``sharding.plan_slabs`` cuts the epoch into ``(slab_steps, batch,
@@ -426,6 +477,10 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
             with trace_lib.span("dispatch", cat="dispatch"):
                 state, total, losses = superstep(state, total, slab, lo,
                                                  hi)
+            if profiler_win is not None:
+                # one captured "superstep" = one dispatch; the window
+                # fences and stops itself after its N-th dispatch
+                profiler_win.note_dispatch(losses)
             end = gstart + hi       # true global steps completed
             counted += hi - lo
             pending += hi - lo
@@ -482,7 +537,7 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
 def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 start_epoch, start_step_in_epoch, metrics, timer, eval_fn,
                 eval_batch, ckpt, superstep=None, k=1, budget_bytes=None,
-                staging=None, observer=None):
+                staging=None, observer=None, profiler_win=None):
     last_avg = float("nan")
     staging = StagingStats() if staging is None else staging
     for epoch in range(start_epoch, cfg.epochs):
@@ -492,6 +547,10 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
         # overhead) to the "train" phase
         epoch_span = trace_lib.get().begin("epoch", cat="train",
                                            epoch=epoch)
+        if profiler_win is not None:
+            # the capture window opens at its trigger epoch's first
+            # dispatch — mid-run steady state, not the compile epoch
+            profiler_win.maybe_start(epoch)
         plan = epoch_plan(epoch)
         n_steps = plan.n_steps
         # mid-epoch resume: the epoch's batch order is stateless by
@@ -513,7 +572,7 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             state, total, counted, pending = _superstep_epoch(
                 cfg, k, mesh, state, superstep, plan, first, n_steps,
                 epoch, metrics, timer, ckpt, budget_bytes, staging,
-                observer=observer)
+                observer=observer, profiler_win=profiler_win)
             last_avg = _epoch_end(cfg, state, total, counted, pending,
                                   n_steps, epoch, metrics, timer, eval_fn,
                                   eval_batch, ckpt, observer=observer)
@@ -525,6 +584,9 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             batch = jax.tree.map(lambda a: a[i], batches)
             with trace_lib.span("dispatch", cat="dispatch"):
                 state, loss = train_step(state, batch)
+            if profiler_win is not None:
+                # per-step dispatch: each step is its own dispatch group
+                profiler_win.note_dispatch(loss)
             total = loss if total is None else total + loss
             counted += 1
             pending += 1

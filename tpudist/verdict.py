@@ -18,7 +18,11 @@ from __future__ import annotations
 import os
 import subprocess
 
-import jax
+# NO top-level jax import: this module sits on the jax-free offline
+# path (obs.report ← obs.__init__ ← obs.hoststats ← here), which must
+# run on a laptop with nothing but the stdlib + numpy installed. The
+# three functions that genuinely need the distributed runtime import
+# jax at call time.
 
 SUCCESS = "success"
 FAIL = "fail"
@@ -148,6 +152,19 @@ def trace_status(enabled: bool, spans: int, dropped: int,
     return SUCCESS
 
 
+def comm_status(exposed_frac, max_frac: float | None = None) -> str:
+    """Three-valued exposed-communication verdict (tpudist.obs.devtime,
+    ``--profile-window`` capture): UNGATEABLE with no device window
+    measured, else SUCCESS/FAIL by whether the exposed-comm fraction
+    stays under ``TPUDIST_COMM_EXPOSED_MAX``. The implementation lives
+    in obs.devtime next to the interval math that produces the
+    fraction; this delegator keeps the train loop's verdict surface in
+    one place like the other gates. (Lazy import: devtime imports this
+    module for the status vocabulary.)"""
+    from tpudist.obs.devtime import comm_status as _impl
+    return _impl(exposed_frac, max_frac)
+
+
 def _write(path: str, content: str) -> None:
     if path.startswith("gs://"):
         # shell-free: path/content go as argv/stdin, immune to metacharacters
@@ -166,6 +183,7 @@ def _write(path: str, content: str) -> None:
 def write_worker_verdict(path: str, ok: bool) -> None:
     """Per-worker verdict: ``<path>.worker<i>`` (all ranks call this —
     parity with every rank participating in the status protocol)."""
+    import jax
     _write(f"{path}.worker{jax.process_index()}", SUCCESS if ok else FAIL)
 
 
@@ -178,6 +196,7 @@ def write_final_verdict(path: str, ok: bool) -> None:
 def write_final_status(path: str, status: str) -> None:
     """Coordinator-only: write an explicit status string (SUCCESS / FAIL /
     UNGATEABLE) — the three-valued form of :func:`write_final_verdict`."""
+    import jax
     if jax.process_index() == 0:
         _write(path, status)
 
@@ -200,6 +219,7 @@ def aggregate_status(local_ok: bool,
     same dead peer, or race the abandoned allgather) and just exit —
     which is exactly what train.main does (r3 review: tighter
     cancellation story)."""
+    import jax
     if jax.process_count() == 1:
         return local_ok, False
     import os
