@@ -456,6 +456,98 @@ def run_tune_sweep(out_path: str, n_steps: int = 128,
     return art
 
 
+# ------------------------------------------------------------- ckpt sweep
+
+
+def run_ckpt_sweep(out_path: str, n_steps: int = 64, repeats: int = 4,
+                   k: int = 8) -> dict:
+    """The checkpoint-overhead curve: tiny-MLP steps/s at k=8 with an
+    epoch-end save under each checkpoint mode — none (baseline),
+    orbax-sync, orbax-async, and the elastic sharded-manifest writer
+    (tpudist.elastic.ckpt) — on the shared tune.probe epoch harness, so
+    the rows are directly comparable to BENCH_DISPATCH/BENCH_STAGING.
+    Each row splits the save cost the honest way the Checkpointer does:
+    ``enqueue_ms`` (what the train loop pays inline, snapshot+handoff),
+    ``drain_ms`` (the blocked time at close that async modes defer), and
+    the steps/s DIP vs the no-checkpoint baseline (save windows timed
+    INSIDE the per-epoch wall, so hidden async cost stays hidden and
+    exposed sync cost shows). The tracked artifact metric is the
+    sharded-manifest dip — the price of preemption survival."""
+    import shutil
+    import tempfile
+
+    from tpudist import checkpoint as ckpt_lib
+    from tpudist.elastic import ckpt as elastic_ckpt
+    from tpudist.parallel import build_mesh
+    from tpudist.tune import probe
+
+    cfg = TrainConfig(batch_size=64, lr=1e-3, seed=0,
+                      data=DataConfig(n_samples=n_steps * 64),
+                      parallel=ParallelConfig(data=-1))
+    mesh = build_mesh(cfg.parallel)
+    plan = _sweep_plan(cfg, n_steps)
+
+    def make_ckpt(mode, d):
+        if mode == "none":
+            return None
+        if mode == "sharded":
+            return elastic_ckpt.ShardedCheckpointer(d, use_async=True)
+        return ckpt_lib.Checkpointer(d, use_async=(mode == "orbax-async"))
+
+    rows = []
+    for mode in ("none", "orbax-sync", "orbax-async", "sharded"):
+        d = tempfile.mkdtemp(prefix=f"tpudist_ckpt_{mode}_")
+        runner = probe.EpochRunner(cfg, mesh, k, plan, n_steps)
+        state = runner.init_state()
+        state, loss = runner.run_epoch(state)    # trace + compile + warm
+        jax.device_get(loss)
+        ck = make_ckpt(mode, d)
+        ms, enq = [], []
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            state, loss = runner.run_epoch(state)
+            jax.device_get(loss)                 # fence
+            if ck is not None:
+                ck.save(state, epoch=r + 1, step_in_epoch=0)
+                enq.append(ck.last_enqueue_ms)
+            ms.append((time.perf_counter() - t0) * 1000 / n_steps)
+        t0 = time.perf_counter()
+        if ck is not None:
+            ck.close()
+        drain = (time.perf_counter() - t0) * 1000
+        shutil.rmtree(d, ignore_errors=True)
+        step_ms = statistics.median(ms)
+        rows.append({
+            "mode": mode, "step_ms": round(step_ms, 4),
+            "steps_per_sec": round(1000 / step_ms, 1),
+            "enqueue_ms_mean": (round(statistics.mean(enq), 2)
+                                if enq else None),
+            "enqueue_ms_max": round(max(enq), 2) if enq else None,
+            "drain_ms": round(drain, 2) if ck is not None else None,
+            "saves": len(enq)})
+    base = rows[0]["steps_per_sec"]
+    for r in rows:
+        r["steps_dip_pct"] = round(100 * (1 - r["steps_per_sec"] / base), 2)
+    by_mode = {r["mode"]: r for r in rows}
+    art = {
+        "metric": "ckpt_sharded_steps_dip_pct",
+        "value": by_mode["sharded"]["steps_dip_pct"],
+        "unit": "% steps/s lost to sharded-manifest epoch saves vs no "
+                "checkpointing (tiny MLP, k=8)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "model": "mlp", "global_batch": cfg.batch_size,
+            "k": k, "n_steps": n_steps, "saves_per_mode": repeats,
+            "rows": rows,
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps(art))
+    return art
+
+
 # --------------------------------------------------------- collective sweep
 
 
@@ -641,6 +733,13 @@ def main() -> None:
                         "steps/s, cache re-hit); write BENCH_TUNE.json")
     p.add_argument("--tune-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_TUNE.json"))
+    p.add_argument("--ckpt-sweep", action="store_true",
+                   help="bench checkpoint save overhead (none vs "
+                        "orbax-sync vs orbax-async vs elastic sharded "
+                        "manifest): enqueue/drain ms + steps/s dip; "
+                        "write BENCH_CKPT.json")
+    p.add_argument("--ckpt-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_CKPT.json"))
     p.add_argument("--collective-sweep", action="store_true",
                    help="sweep the collectives over the mesh's data "
                         "axis (ICI/DCN-labeled) and write "
@@ -677,6 +776,9 @@ def main() -> None:
         return
     if args.tune_sweep:
         run_tune_sweep(args.tune_out)
+        return
+    if args.ckpt_sweep:
+        run_ckpt_sweep(args.ckpt_out)
         return
     if args.collective_sweep:
         run_collective_sweep(args.collective_out, args.collective_kinds,

@@ -43,6 +43,17 @@
 #   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
 #                       (debugging a slice with a known-red kernel)
 #   SKIP_TESTS_TPU=1    bypass the on-chip pytest lane (tests_tpu/)
+#   MAX_REQUEUES        auto-requeue budget (default 0 = off): a failed/
+#                       stalled training job is classified by
+#                       tpudist.elastic.policy (run on THIS host, jax-free)
+#                       from its exit code + collected flight records +
+#                       per-worker verdicts — preemption/stall reruns the
+#                       job with --resume auto against the last committed
+#                       checkpoint (exponential backoff, re-provisioning
+#                       the slice if it too was preempted); a
+#                       deterministic crash stops immediately
+#   REQUEUE_BACKOFF_S   requeue backoff base in seconds (default 10;
+#                       doubles per attempt, capped at 300)
 #   RUN_SWEEP=1         run the gated bandwidth sweep after training
 #   SWEEP_MIN_PCT       sweep gate threshold (default 90, BASELINE.md)
 #   SWEEP_PEAK_GBPS     operator override for the ICI ring peak (GB/s) —
@@ -68,6 +79,11 @@ OBS_DIR="${OBS_DIR:-/tmp/tpudist_obs}"
 POLL_S="${POLL_S:-10}"   # provisioning poll interval (tests shrink it)
 SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
+MAX_REQUEUES="${MAX_REQUEUES:-0}"
+REQUEUE_BACKOFF_S="${REQUEUE_BACKOFF_S:-10}"
+# the requeue policy runs on THIS host (it is stdlib-only python); the
+# repo root sits one level above this script
+SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
 
 # shell-quote every extra workload flag: flags with spaces/metacharacters
 # must survive the ssh --command round-trip verbatim
@@ -94,30 +110,42 @@ fail_verdict() {
   echo -n fail | gsutil cp - "$GCS_VERDICT" || true
 }
 
-echo "creating queued resource $TPU_NAME ($ACCELERATOR_TYPE) ..."
-gcloud compute tpus queued-resources create "$TPU_NAME" \
-  --node-id "$TPU_NAME" \
-  --zone "$ZONE" --project "$PROJECT" \
-  --accelerator-type "$ACCELERATOR_TYPE" \
-  --runtime-version "$RUNTIME_VERSION"
+slice_state() {
+  gcloud compute tpus queued-resources describe "$TPU_NAME" \
+    --zone "$ZONE" --project "$PROJECT" \
+    --format='value(state.state)' 2>/dev/null || echo UNKNOWN
+}
 
-# poll until ACTIVE — provisioning is async and can WAIT indefinitely;
-# same timeout discipline as the reference CI's squeue loop (ci:130-150)
-deadline=$((SECONDS + TIMEOUT_S))
-while :; do
-  state=$(gcloud compute tpus queued-resources describe "$TPU_NAME" \
-            --zone "$ZONE" --project "$PROJECT" \
-            --format='value(state.state)' 2>/dev/null || echo UNKNOWN)
-  echo "queued-resource state: $state"
-  case "$state" in
-    ACTIVE) break ;;
-    FAILED|SUSPENDED) echo "provisioning failed: $state"; fail_verdict; exit 1 ;;
-  esac
-  if (( SECONDS > deadline )); then
-    echo "timeout waiting for TPU slice"; fail_verdict; exit 124
-  fi
-  sleep "$POLL_S"
-done
+provision_slice() {
+  echo "creating queued resource $TPU_NAME ($ACCELERATOR_TYPE) ..."
+  gcloud compute tpus queued-resources create "$TPU_NAME" \
+    --node-id "$TPU_NAME" \
+    --zone "$ZONE" --project "$PROJECT" \
+    --accelerator-type "$ACCELERATOR_TYPE" \
+    --runtime-version "$RUNTIME_VERSION"
+}
+
+wait_active() {
+  # poll until ACTIVE — provisioning is async and can WAIT indefinitely;
+  # same timeout discipline as the reference CI's squeue loop (ci:130-150)
+  local deadline=$((SECONDS + TIMEOUT_S))
+  while :; do
+    local state
+    state=$(slice_state)
+    echo "queued-resource state: $state"
+    case "$state" in
+      ACTIVE) return 0 ;;
+      FAILED|SUSPENDED) echo "provisioning failed: $state"; fail_verdict; exit 1 ;;
+    esac
+    if (( SECONDS > deadline )); then
+      echo "timeout waiting for TPU slice"; fail_verdict; exit 124
+    fi
+    sleep "$POLL_S"
+  done
+}
+
+provision_slice
+wait_active
 
 # ---- expected chip count from the accelerator type -------------------------
 # vXp-N / vX-N name TensorCores (2 per chip, 1 jax device per chip);
@@ -129,26 +157,32 @@ case "$ACCELERATOR_TYPE" in
 esac
 
 # ---- workload delivery -----------------------------------------------------
-if [ -n "${IMAGE:-}" ]; then
-  # /tmp is mounted so the sweep's JSONL artifact lands on the host VM
-  RUN_PREFIX="sudo docker run --rm --privileged --network host -v /tmp:/tmp $IMAGE"
-  tpu_ssh all "sudo docker pull $IMAGE"
-  TESTS_TPU_PATH="tests_tpu"     # baked into the image at /workspace
-else
-  # bare path: nothing on a fresh TPU-VM has the package — ship this repo
-  # (incl. the hardware test lane) as an sdist-style tarball and
-  # pip-install it on every worker
-  PKG_TGZ=$(mktemp /tmp/tpudist_pkg.XXXXXX.tgz)
-  tar -czf "$PKG_TGZ" -C "$(dirname "$0")/.." pyproject.toml tpudist tests_tpu
-  gcloud compute tpus tpu-vm scp "$PKG_TGZ" "$TPU_NAME:tpudist_pkg.tgz" \
-    --zone "$ZONE" --project "$PROJECT" --worker=all
-  tpu_ssh all "rm -rf ~/tpudist_src && mkdir -p ~/tpudist_src && \
-    tar xzf ~/tpudist_pkg.tgz -C ~/tpudist_src && \
-    pip3 install --quiet --user ~/tpudist_src pytest"
-  rm -f "$PKG_TGZ"
-  RUN_PREFIX=""
-  TESTS_TPU_PATH="~/tpudist_src/tests_tpu"
-fi
+deliver_workload() {
+  if [ -n "${IMAGE:-}" ]; then
+    # /tmp is mounted so the sweep's JSONL artifact lands on the host VM;
+    # the per-worker verdict path (below) rides the same mount
+    RUN_PREFIX="sudo docker run --rm --privileged --network host -v /tmp:/tmp \
+      -e TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt $IMAGE"
+    tpu_ssh all "sudo docker pull $IMAGE"
+    TESTS_TPU_PATH="tests_tpu"     # baked into the image at /workspace
+  else
+    # bare path: nothing on a fresh TPU-VM has the package — ship this repo
+    # (incl. the hardware test lane) as an sdist-style tarball and
+    # pip-install it on every worker
+    local PKG_TGZ
+    PKG_TGZ=$(mktemp /tmp/tpudist_pkg.XXXXXX.tgz)
+    tar -czf "$PKG_TGZ" -C "$SCRIPT_DIR/.." pyproject.toml tpudist tests_tpu
+    gcloud compute tpus tpu-vm scp "$PKG_TGZ" "$TPU_NAME:tpudist_pkg.tgz" \
+      --zone "$ZONE" --project "$PROJECT" --worker=all
+    tpu_ssh all "rm -rf ~/tpudist_src && mkdir -p ~/tpudist_src && \
+      tar xzf ~/tpudist_pkg.tgz -C ~/tpudist_src && \
+      pip3 install --quiet --user ~/tpudist_src pytest"
+    rm -f "$PKG_TGZ"
+    RUN_PREFIX=""
+    TESTS_TPU_PATH="~/tpudist_src/tests_tpu"
+  fi
+}
+deliver_workload
 
 # ---- live topology probe ---------------------------------------------------
 # Before training: initialize distributed across ALL workers and assert the
@@ -161,15 +195,18 @@ n = jax.device_count()
 ok = n == int(sys.argv[1])
 print(f'probe: {n} global devices, expected {sys.argv[1]}, ok={ok}')
 sys.exit(0 if ok else 1)"
-set +e
-tpu_ssh all "$RUN_PREFIX python3 -c $(printf '%q' "$PROBE") $EXPECTED_CHIPS"
-PROBE_RC=$?
-set -e
-if [ $PROBE_RC -ne 0 ]; then
-  echo "❌ slice probe failed: provisioned slice does not match $ACCELERATOR_TYPE"
-  fail_verdict
-  exit 1
-fi
+probe_slice() {
+  set +e
+  tpu_ssh all "$RUN_PREFIX python3 -c $(printf '%q' "$PROBE") $EXPECTED_CHIPS"
+  PROBE_RC=$?
+  set -e
+  if [ $PROBE_RC -ne 0 ]; then
+    echo "❌ slice probe failed: provisioned slice does not match $ACCELERATOR_TYPE"
+    fail_verdict
+    exit 1
+  fi
+}
+probe_slice
 
 # ---- on-chip kernel self-check (hardware truth gates the pipeline) ---------
 # ALL workers run the Mosaic-compiled kernel lane (tpudist.selfcheck)
@@ -212,7 +249,7 @@ if [ "${SKIP_TESTS_TPU:-0}" != "1" ]; then
   echo "✅ on-chip test lane passed"
 fi
 
-# ---- the distributed training job ------------------------------------------
+# ---- the distributed training job (with auto-requeue) ----------------------
 # Any worker's nonzero exit fails the ssh command (srun semantics,
 # slurm_train.sbatch:34-44). The verdict is this wrapper's job, from the
 # workload's exit code (same division of labor as the reference sbatch).
@@ -227,41 +264,120 @@ fi
 # --trace-dir: span traces land in OBS_DIR too, so the same collection
 # path covers the timeline artifacts (trace.worker<i>.json on every
 # worker; the coordinator's merged pod_trace.json on success)
-set +e
-tpu_ssh all "timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
-  --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$EXTRA_Q"
-RC=$?
-set -e
+# --resume auto: every attempt resumes from the last committed
+# checkpoint when one exists, else starts fresh — so a requeued job
+# (preemption/stall verdict from tpudist.elastic.policy, budgeted by
+# MAX_REQUEUES) continues instead of restarting from step 0.
 
-collect_flight_records() {
+collect_flight_records() {  # collect_flight_records <dest-dir>
   # Pull heartbeat beacons + flight-record dumps off every worker: the
   # whole point of the flight recorder is that a hung run leaves
   # evidence of WHICH host and WHICH step died — it must land on the CI
-  # host before the slice is torn down. Per-worker filenames
+  # host before the slice is torn down (and it feeds the requeue
+  # policy's stall/preemption classification). Per-worker filenames
   # (flightrec.worker<i>) cannot collide. Best-effort: a dead worker
-  # must not block the verdict.
-  echo "collecting flight-recorder artifacts from $OBS_DIR ..."
-  mkdir -p flightrec_artifacts
+  # must not block the verdict. The destination is PER-ATTEMPT under
+  # the requeue loop: the policy must classify each failure from that
+  # attempt's evidence only — a stall dump left over from attempt 0
+  # must not make attempt 1's deterministic crash look requeue-able.
+  local dest="${1:-flightrec_artifacts}"
+  echo "collecting flight-recorder artifacts from $OBS_DIR into $dest ..."
+  mkdir -p "$dest"
   gcloud compute tpus tpu-vm scp --recurse "$TPU_NAME:$OBS_DIR/*" \
-    flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
+    "$dest/" --zone "$ZONE" --project "$PROJECT" \
     --worker=all 2>/dev/null || true
-  ls -l flightrec_artifacts/ 2>/dev/null || true
+  ls -l "$dest/" 2>/dev/null || true
 }
 
-if [ $RC -ne 0 ]; then
+attempt=0
+while :; do
+  if [ "$attempt" -gt 0 ]; then
+    # the SLICE itself may be what got preempted: a queued resource that
+    # left ACTIVE cannot be ssh'd back to life — re-provision, re-ship
+    # the workload, re-probe, then resume training from the manifest.
+    # UNKNOWN means the describe call itself failed; retry before
+    # concluding anything — one flaky API call must not get a healthy
+    # ACTIVE slice deleted and sent back into the provisioning queue
+    state=$(slice_state)
+    for _ in 1 2 3; do
+      [ "$state" != "UNKNOWN" ] && break
+      sleep "$POLL_S"
+      state=$(slice_state)
+    done
+    if [ "$state" = "UNKNOWN" ]; then
+      echo "slice state UNKNOWN after retries — attempting the rerun" \
+           "without re-provisioning (ssh will fail if it is truly gone)"
+    elif [ "$state" != "ACTIVE" ]; then
+      echo "slice state $state on requeue — re-provisioning ..."
+      gcloud compute tpus queued-resources delete "$TPU_NAME" \
+        --zone "$ZONE" --project "$PROJECT" --quiet --force 2>/dev/null || true
+      provision_slice
+      wait_active
+      deliver_workload
+      probe_slice
+    fi
+  fi
+  # --resume auto only under an explicit requeue budget: the
+  # pre-elastic contract (every launch trains from scratch) holds
+  # unless the operator opted into elasticity
+  RESUME_FLAGS=""
+  if [ "$MAX_REQUEUES" -gt 0 ]; then
+    RESUME_FLAGS=" --resume auto --requeue-attempt $attempt"
+  fi
+  # TPUDIST_VERDICT_PATH into OBS_DIR: every worker's orderly death
+  # writes job_status.txt.worker<i> next to its heartbeat beacon, and
+  # the collection below ships both — the policy's vanished-worker
+  # inference (beacon present, verdict absent => preempted) keys off
+  # exactly this pairing. (Containerised runs get the env via
+  # RUN_PREFIX's -e; OBS_DIR rides the /tmp mount.)
+  set +e
+  tpu_ssh all "TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt \
+    timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
+    --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$RESUME_FLAGS$EXTRA_Q"
+  RC=$?
+  set -e
+  [ $RC -eq 0 ] && break
+
   if [ $RC -eq 124 ]; then
     echo "❌ distributed TPU job TIMED OUT after ${TIMEOUT_S}s (hang — " \
          "see flight records for the wedged host/step)"
   else
     echo "❌ distributed TPU job failed (rc=$RC)"
   fi
-  collect_flight_records
+  # per-attempt evidence dir; old worker-side dumps AND verdict files
+  # are cleared after collection so the NEXT attempt's classification
+  # can't see them (a stale verdict would mask a vanished worker; a
+  # stale stall dump would requeue a deterministic crash)
+  ATTEMPT_DIR="flightrec_artifacts/attempt$attempt"
+  collect_flight_records "$ATTEMPT_DIR"
+  tpu_ssh all "rm -f $OBS_DIR/flightrec.worker* $OBS_DIR/job_status.txt*" \
+    2>/dev/null || true
+  # requeue-or-stop: the jax-free policy classifies the failure from the
+  # exit code + this attempt's flight records. Exit 0 = requeue;
+  # anything else (stop verdict, or the policy itself broke) = stop.
+  set +e
+  DECISION=$(PYTHONPATH="$SCRIPT_DIR/..${PYTHONPATH:+:$PYTHONPATH}" \
+    python3 -m tpudist.elastic.policy --rc "$RC" --attempt "$attempt" \
+    --max-requeues "$MAX_REQUEUES" --flightrec-dir "$ATTEMPT_DIR" \
+    --backoff-base-s "$REQUEUE_BACKOFF_S")
+  POLICY_RC=$?
+  set -e
+  echo "requeue policy: ${DECISION:-<policy unavailable>}"
+  if [ "$POLICY_RC" -eq 0 ]; then
+    BACKOFF=$(printf '%s\n' "$DECISION" \
+      | sed -n 's/.*BACKOFF_S=\([0-9.]*\).*/\1/p')
+    attempt=$((attempt + 1))
+    echo "⟳ requeue attempt $attempt/$MAX_REQUEUES after" \
+         "${BACKOFF:-$REQUEUE_BACKOFF_S}s backoff (--resume auto)"
+    sleep "${BACKOFF:-$REQUEUE_BACKOFF_S}"
+    continue
+  fi
   fail_verdict
   # clamp to 1: the workload's raw code must not collide with this
   # script's documented exit contract (2 = sweep gate fail, 3 = sweep
   # ungateable, 124 = provisioning timeout)
   exit 1
-fi
+done
 echo "✅ distributed TPU job succeeded"
 echo -n success | gsutil cp - "$GCS_VERDICT"
 

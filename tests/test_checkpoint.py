@@ -169,6 +169,40 @@ def test_restore_full_reads_legacy_epoch_layout(tmp_path, cfg, devices8):
         np.asarray(a), np.asarray(b)), state, restored)
 
 
+def test_restore_latest_honors_step_keyed_resume_meta(tmp_path, cfg,
+                                                      devices8):
+    """The simple path on a Checkpointer-written (step-keyed) dir must
+    honor the (epoch, step_in_epoch) resume metadata: the old code
+    returned latest_step + 1 — a GLOBAL step masquerading as an epoch,
+    silently restarting training far past the end of the run."""
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = _state(cfg, mesh)
+    state = state._replace(step=state.step + 40)   # global step 40
+    ck = checkpoint.Checkpointer(str(tmp_path), use_async=False)
+    ck.save(state, epoch=5, step_in_epoch=0)       # resume: epoch 5, batch 0
+    ck.close()
+    restored, next_epoch = checkpoint.restore_latest(str(tmp_path), state)
+    assert next_epoch == 5, \
+        f"simple path must honor the resume metadata, got {next_epoch}"
+    jax.tree.map(lambda a, b: np.testing.assert_array_equal(
+        np.asarray(a), np.asarray(b)), state, restored)
+
+
+def test_restore_latest_warns_on_midepoch_position(tmp_path, cfg,
+                                                   devices8, capfd):
+    """A mid-epoch save through the simple API: the returned epoch is
+    the one to CONTINUE (conservative restart from batch 0) and a
+    warning points at restore_latest_full for the exact position."""
+    mesh = build_mesh(cfg.parallel, devices=devices8)
+    state = _state(cfg, mesh)
+    ck = checkpoint.Checkpointer(str(tmp_path), use_async=False)
+    ck.save(state, epoch=2, step_in_epoch=6)
+    ck.close()
+    _, next_epoch = checkpoint.restore_latest(str(tmp_path), state)
+    assert next_epoch == 2
+    assert "restore_latest_full" in capfd.readouterr().err
+
+
 def _final_params(save_dir, cfg, mesh):
     template = _state(cfg, mesh)
     restored, _, _ = checkpoint.restore_latest_full(str(save_dir), template)

@@ -49,6 +49,16 @@ case "$*" in
     elif [[ "$*" == *" pytest "* || "$*" == *"-m pytest"* ]]; then
       exit "${STUB_TESTS_TPU_RC:-0}"
     elif [[ "$*" == *"tpudist.train"* ]]; then
+      # requeue drills: fail the first STUB_TRAIN_FAIL_N attempts with
+      # STUB_TRAIN_RC, then succeed (a preemption that resolves)
+      if [ -n "${STUB_TRAIN_FAIL_N:-}" ]; then
+        n=$(cat "$STUB_DIR/train_n" 2>/dev/null || echo 0)
+        echo $((n+1)) > "$STUB_DIR/train_n"
+        if [ "$n" -lt "$STUB_TRAIN_FAIL_N" ]; then
+          exit "${STUB_TRAIN_RC:-137}"
+        fi
+        exit 0
+      fi
       exit "${STUB_TRAIN_RC:-0}"
     elif [[ "$*" == *"tpudist.bench.sweep"* ]]; then
       exit "${STUB_SWEEP_RC:-0}"
@@ -335,6 +345,72 @@ def test_bare_path_installs_package_on_workers(stub_env):
     calls = (stub / "calls.log").read_text()
     assert "tpu-vm scp" in calls and "pip3 install" in calls, \
         "bare path must ship + install the package (r1 advisor finding)"
+
+
+def _train_lines(stub):
+    return [ln for ln in (stub / "calls.log").read_text().splitlines()
+            if "tpudist.train" in ln]
+
+
+def test_requeue_on_preemption_then_success(stub_env):
+    """A signal-killed job (rc=137, the preemption reaper) with a
+    requeue budget reruns with --resume auto and an incremented
+    --requeue-attempt; the second (clean) attempt yields a green
+    verdict. Flight records are collected for the failed attempt."""
+    env, stub = stub_env
+    env.update(MAX_REQUEUES="2", REQUEUE_BACKOFF_S="0",
+               STUB_TRAIN_FAIL_N="1", STUB_TRAIN_RC="137")
+    r = launch(env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert verdict(stub) == "success"
+    assert "VERDICT=preemption REQUEUE=1" in r.stdout, r.stdout
+    trains = _train_lines(stub)
+    assert len(trains) == 2, trains
+    assert all("--resume auto" in t for t in trains)
+    assert "--requeue-attempt 0" in trains[0]
+    assert "--requeue-attempt 1" in trains[1]
+    # the failed attempt's flight records were pulled before the rerun
+    scp = [ln for ln in (stub / "calls.log").read_text().splitlines()
+           if "scp" in ln and "tpudist_obs" in ln and "--worker=all" in ln]
+    assert scp, "requeue must still collect the dead attempt's evidence"
+
+
+def test_crash_is_not_requeued_even_with_budget(stub_env):
+    """rc=1 with no stall/preemption evidence is a deterministic crash:
+    the policy stops immediately — a requeue budget must not buy a
+    crash-loop."""
+    env, stub = stub_env
+    env.update(MAX_REQUEUES="3", REQUEUE_BACKOFF_S="0",
+               STUB_TRAIN_RC="1")
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    assert "VERDICT=crash REQUEUE=0" in r.stdout, r.stdout
+    assert len(_train_lines(stub)) == 1
+
+
+def test_requeue_budget_exhausted_fails(stub_env):
+    """Preemptions past the budget stop with a fail verdict — the
+    requeue loop is bounded."""
+    env, stub = stub_env
+    env.update(MAX_REQUEUES="1", REQUEUE_BACKOFF_S="0",
+               STUB_TRAIN_FAIL_N="5", STUB_TRAIN_RC="137")
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    assert "requeue budget exhausted" in r.stdout, r.stdout
+    assert len(_train_lines(stub)) == 2          # initial + 1 requeue
+
+
+def test_no_requeue_by_default(stub_env):
+    """MAX_REQUEUES defaults to 0: a signal death fails immediately
+    (the pre-elastic contract holds unless the operator opts in)."""
+    env, stub = stub_env
+    env.update(STUB_TRAIN_RC="137")
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    assert len(_train_lines(stub)) == 1
 
 
 def test_image_path_skips_install_uses_docker(stub_env):

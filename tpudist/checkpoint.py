@@ -137,6 +137,20 @@ class Checkpointer:
         self.drain_ms += self.last_drain_ms
 
 
+def latest_step(save_dir: str) -> Optional[int]:
+    """The newest orbax checkpoint key in ``save_dir`` (a global step
+    for Checkpointer-written dirs, an epoch for legacy ones), or None —
+    a cheap PEEK that restores nothing. The elastic resume path
+    (tpudist.elastic.resume) uses it to pick the furthest-progressed
+    checkpoint when a sharded manifest and orbax steps coexist."""
+    if not _exists(_norm(save_dir)):
+        return None
+    mgr = _manager(save_dir, None)
+    step = mgr.latest_step()
+    mgr.close()
+    return step
+
+
 def restore_latest_full(save_dir: str, template: Any
                         ) -> Optional[Tuple[Any, int, int]]:
     """Restore the newest step-keyed checkpoint as (state, epoch,
@@ -182,16 +196,29 @@ def save(save_dir: str, state: Any, *, epoch: int,
 
 def restore_latest(save_dir: str, template: Any
                    ) -> Optional[Tuple[Any, int]]:
-    """Restore the newest epoch-keyed checkpoint as (state, next_epoch),
-    or None if the directory holds none."""
-    if not _exists(_norm(save_dir)):
+    """Restore the newest checkpoint as (state, next_epoch), or None if
+    the directory holds none.
+
+    Honors the ``(epoch, step_in_epoch)`` resume metadata that
+    :class:`Checkpointer` writes: on a step-keyed directory the returned
+    epoch is the metadata's resume epoch, NOT ``latest_step + 1`` (which
+    is a GLOBAL step on those layouts — the old behavior silently
+    restarted training epochs(!) past the end of the run). The simple
+    2-tuple API cannot express a mid-epoch position; when the newest
+    save carries ``step_in_epoch > 0`` a warning points at
+    :func:`restore_latest_full`, and the returned epoch restarts that
+    epoch from batch 0 — conservative (some batches retrain) but never
+    skips data. Legacy epoch-keyed directories behave exactly as
+    before: ``(state, epoch + 1)``."""
+    out = restore_latest_full(save_dir, template)
+    if out is None:
         return None
-    mgr = _manager(save_dir, None)
-    step = mgr.latest_step()
-    if step is None:
-        mgr.close()
-        return None
-    abstract = jax.tree.map(ocp.utils.to_shape_dtype_struct, template)
-    state = mgr.restore(step, args=ocp.args.StandardRestore(abstract))
-    mgr.close()
-    return state, step + 1
+    state, epoch, step_in_epoch = out
+    if step_in_epoch:
+        import sys
+        print(f"tpudist: restore_latest: newest checkpoint resumes "
+              f"mid-epoch (epoch {epoch}, step {step_in_epoch}); the "
+              f"simple API restarts epoch {epoch} from batch 0 — use "
+              f"restore_latest_full for the exact position",
+              file=sys.stderr, flush=True)
+    return state, epoch

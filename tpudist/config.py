@@ -14,7 +14,7 @@ import argparse
 import dataclasses
 import os
 from dataclasses import dataclass
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 
 # context-parallel attention implementations (single source of truth;
@@ -82,9 +82,21 @@ class TrainConfig:
     lr: float = 1e-3
     seed: int = 42
     save_dir: str = "ckpt"
-    resume: bool = False
+    resume: Any = False           # False = off; True/"latest" = resume and
+    # RAISE if the newest checkpoint cannot drive this run; "auto" = the
+    # launcher-requeue mode — resume when a committed checkpoint exists,
+    # fall back to a fresh start (flagged resume_status=fail) when the
+    # restore errors, never crash-loop (resolve_resume)
     ckpt_every_steps: int = 0     # also save mid-epoch every N steps (0=off)
     ckpt_sync: bool = False       # disable async checkpointing (debugging)
+    ckpt_mode: Optional[str] = None  # orbax | sharded (elastic/ckpt.py:
+    # per-worker shard files + atomically committed manifest — the
+    # reshardable layout elastic resume consumes). None =
+    # $TPUDIST_CKPT_MODE, else orbax (resolve_ckpt_mode)
+    requeue_attempt: int = 0      # which auto-requeue rerun this is (the
+    # launcher passes it; 0 = first attempt / not requeued). Rides into
+    # the kind=resume record / resume_status line
+    # ($TPUDIST_REQUEUE_ATTEMPT when 0)
     grad_accum_steps: int = 1
     dtype: str = "float32"        # compute dtype: float32 | bfloat16
     adam_nu_dtype: str = "float32"  # Adam second-moment storage dtype
@@ -320,6 +332,62 @@ def resolve_autotune_trials(cfg: TrainConfig) -> int:
     return int(env) if env and env > 0 else AUTOTUNE_DEFAULT_TRIALS
 
 
+# Elastic checkpoint/resume (tpudist.elastic): the checkpoint layout and
+# the resume semantics are separate knobs — the layout decides what a
+# kill can lose, the resume mode decides what a restart does about it.
+CKPT_MODES = ("orbax", "sharded")
+RESUME_MODES = ("latest", "auto")
+
+
+def resolve_ckpt_mode(cfg: TrainConfig) -> str:
+    """Resolve ``--ckpt-mode`` / ``TPUDIST_CKPT_MODE`` to the concrete
+    checkpoint layout: ``orbax`` (step-keyed CheckpointManager — the
+    default, and the only mode that writes ``gs://`` URIs natively) or
+    ``sharded`` (tpudist.elastic.ckpt: per-worker shard files + an
+    atomically committed manifest on a pod-shared filesystem — the
+    layout elastic N→M resume reshards from). ``--ckpt-sync`` composes
+    with either: it selects synchronous writes within the mode."""
+    mode = cfg.ckpt_mode
+    if mode is None:
+        mode = os.environ.get("TPUDIST_CKPT_MODE") or "orbax"
+    if mode not in CKPT_MODES:
+        raise ValueError(
+            f"--ckpt-mode must be one of {CKPT_MODES}, got {mode!r}")
+    if mode == "sharded" and "://" in cfg.save_dir:
+        raise ValueError(
+            f"--ckpt-mode sharded writes plain files on a pod-shared "
+            f"filesystem and cannot target {cfg.save_dir!r}; keep "
+            f"--ckpt-mode orbax for remote URIs (or mount the bucket)")
+    return mode
+
+
+def resolve_resume(cfg: TrainConfig) -> Optional[str]:
+    """Resolve ``--resume`` to a concrete mode or None (off). ``True``
+    (the pre-elastic boolean spelling, kept for compat) means
+    ``latest``. ``latest`` raises when the newest checkpoint cannot
+    drive this run; ``auto`` — what the launcher's requeue loop passes —
+    degrades a failed restore to a flagged fresh start, because a
+    requeued job must make progress, not crash-loop on a torn dir."""
+    r = cfg.resume
+    if not r:
+        return None
+    if r is True:
+        return "latest"
+    if r not in RESUME_MODES:
+        raise ValueError(
+            f"--resume must be one of {RESUME_MODES}, got {r!r}")
+    return r
+
+
+def resolve_requeue_attempt(cfg: TrainConfig) -> int:
+    """Which auto-requeue rerun this is: explicit flag, else
+    ``TPUDIST_REQUEUE_ATTEMPT``, else 0."""
+    if cfg.requeue_attempt:
+        return int(cfg.requeue_attempt)
+    env = _env_float("TPUDIST_REQUEUE_ATTEMPT")
+    return int(env) if env and env > 0 else 0
+
+
 # Span tracing (tpudist.obs.trace): always-on observability, like the
 # flight recorder — the escape hatch exists for runs measuring the last
 # microsecond of host overhead, not as the default posture.
@@ -420,8 +488,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--seed", type=int, default=42)
     p.add_argument("--save-dir", type=str, default="ckpt")
-    p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint in --save-dir")
+    p.add_argument("--resume", nargs="?", const="latest", default=False,
+                   choices=list(RESUME_MODES),
+                   help="resume from the latest checkpoint in --save-dir: "
+                        "bare/latest raises when the checkpoint cannot "
+                        "drive this run; auto (the launcher's requeue "
+                        "mode) prefers the committed elastic manifest, "
+                        "falls back to orbax, and degrades a failed "
+                        "restore to a flagged fresh start")
     p.add_argument("--ckpt-every-steps", type=int, default=0,
                    help="also checkpoint mid-epoch every N steps (0 = "
                         "epoch-end only); a preemption then loses at most "
@@ -429,6 +503,19 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--ckpt-sync", action="store_true",
                    help="synchronous checkpoint writes (async overlap is "
                         "the default)")
+    p.add_argument("--ckpt-mode", type=str, default=None,
+                   choices=list(CKPT_MODES),
+                   help="checkpoint layout: orbax step dirs (default; "
+                        "native gs:// support) or sharded — per-worker "
+                        "shard files + an atomically committed "
+                        "manifest.json (tpudist.elastic), resumable onto "
+                        "a DIFFERENT process/device count (default: "
+                        "$TPUDIST_CKPT_MODE, else orbax)")
+    p.add_argument("--requeue-attempt", type=int, default=0,
+                   help="which auto-requeue rerun this is (the launcher "
+                        "passes it; lands in the kind=resume record and "
+                        "the tpudist: resume line; default: "
+                        "$TPUDIST_REQUEUE_ATTEMPT, else 0)")
     p.add_argument("--model", type=str, default="mlp",
                    choices=["mlp", "transformer", "moe"])
     p.add_argument("--dtype", type=str, default="float32",
@@ -577,6 +664,8 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         resume=args.resume,
         ckpt_every_steps=args.ckpt_every_steps,
         ckpt_sync=args.ckpt_sync,
+        ckpt_mode=args.ckpt_mode,
+        requeue_attempt=args.requeue_attempt,
         grad_accum_steps=args.grad_accum_steps,
         adam_nu_dtype=args.adam_nu_dtype,
         dtype=args.dtype,

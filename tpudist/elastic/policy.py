@@ -1,0 +1,231 @@
+"""Auto-requeue policy: requeue a preempted/stalled job, stop a crashed one.
+
+The launcher (launcher/launch_tpu.sh) reruns a failed training job up to
+``--max-requeues`` times with exponential backoff — but ONLY when the
+evidence says rerunning can help. Blindly requeuing a deterministic
+crash (bad config, NaN loss, broken kernel) burns slice-hours looping on
+the same failure; never requeuing turns every spot preemption into a
+human page. This module is the classifier between the two, consuming
+exactly the artifacts the failure path already collects:
+
+  * the workload's exit code (``124`` = the launcher's outer ``timeout``
+    fired — a hang);
+  * the flight-record dumps (``flightrec.worker<i>``, obs.flightrec):
+    a ``reason: stall`` dump means the watchdog saw a wedged step —
+    the signature of a peer dying mid-collective;
+  * the per-worker verdict files (``job_status.txt.worker<i>``,
+    verdict.write_worker_verdict): a worker that VANISHED without
+    writing one died un-orderly — the signature of a preemption kill
+    (an orderly Python failure always reaches the verdict chain).
+
+Stdlib-only by design: the launcher runs this on the CI host, where
+neither jax nor numpy is guaranteed.
+
+CLI (consumed by launch_tpu.sh; also usable by hand)::
+
+    python3 -m tpudist.elastic.policy --rc 137 --attempt 0 \
+        --max-requeues 3 --flightrec-dir flightrec_artifacts
+
+prints one shell-evalable line::
+
+    VERDICT=preemption REQUEUE=1 BACKOFF_S=10 REASON='...'
+
+and exits 0 to requeue, 1 to stop (any other exit = the policy itself
+broke; the launcher treats that as stop).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+SUCCESS = "success"
+PREEMPTION = "preemption"
+STALL = "stall"
+CRASH = "crash"
+
+# exit codes that mean "killed by signal", not "failed by exception":
+# 128+SIGKILL(9)=137 / 128+SIGTERM(15)=143 are what a preemption reaper
+# or an OOM-killer deliver; 124 is GNU timeout's own hang marker; 255 is
+# ssh/gcloud failing to REACH a worker VM — by the time training runs
+# the launcher has ssh'd every worker repeatedly (delivery, probe,
+# selfcheck), so a sudden 255 means the VM itself went away.
+_SIGNAL_RCS = frozenset({124, 130, 137, 143, 255})
+
+BACKOFF_BASE_S = 10.0
+BACKOFF_MAX_S = 300.0
+
+
+def flightrec_reasons(flightrec_dir: Optional[str]) -> List[str]:
+    """The ``reason`` field of every parseable flight record in the
+    collected-artifacts directory (recursively — the launcher's scp may
+    nest per-worker subdirs). Unparseable files are skipped: a torn
+    dump is not evidence."""
+    if not flightrec_dir or not os.path.isdir(flightrec_dir):
+        return []
+    out = []
+    pattern = os.path.join(flightrec_dir, "**", "flightrec.worker*")
+    for path in sorted(set(glob.glob(pattern, recursive=True))):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            reason = rec.get("reason")
+            if isinstance(reason, str):
+                out.append(reason)
+        except (OSError, ValueError):
+            continue
+    return out
+
+
+def missing_worker_verdicts(verdict_path: Optional[str],
+                            nprocs: Optional[int]) -> Optional[int]:
+    """How many of the expected per-worker verdict files never landed,
+    or None when there is nothing to count against (no path / no
+    process count). A worker that died orderly ALWAYS writes one
+    (train.main's finally); a missing file is a vanished worker."""
+    if not verdict_path or not nprocs or nprocs < 1:
+        return None
+    missing = 0
+    for i in range(nprocs):
+        if not os.path.exists(f"{verdict_path}.worker{i}"):
+            missing += 1
+    return missing
+
+
+def _worker_ids(flightrec_dir: str, prefix: str) -> set:
+    ids = set()
+    for path in glob.glob(os.path.join(flightrec_dir, "**",
+                                       f"{prefix}.worker*"),
+                          recursive=True):
+        tail = os.path.basename(path).rsplit(".worker", 1)[-1]
+        if tail.isdigit():
+            ids.add(int(tail))
+    return ids
+
+
+def vanished_workers(flightrec_dir: Optional[str]) -> List[int]:
+    """Vanished-worker inference from the collected artifacts alone (no
+    --verdict/--nprocs wiring needed): every live worker writes a
+    ``heartbeat.worker<i>`` beacon within seconds of starting, and every
+    ORDERLY death writes a ``job_status.txt.worker<i>`` verdict
+    (train.main's finally) — a worker with a beacon but no verdict died
+    un-orderly, i.e. was preempted. The launcher points the workers'
+    TPUDIST_VERDICT_PATH into the same OBS_DIR it collects (and clears
+    both between attempts), so the sets line up per attempt. Empty when
+    beacons are absent entirely (nothing to infer from)."""
+    if not flightrec_dir or not os.path.isdir(flightrec_dir):
+        return []
+    expected = _worker_ids(flightrec_dir, "heartbeat")
+    wrote = _worker_ids(flightrec_dir, "job_status.txt")
+    return sorted(expected - wrote) if expected else []
+
+
+def classify(rc: int, *, flightrec_dir: Optional[str] = None,
+             verdict_path: Optional[str] = None,
+             nprocs: Optional[int] = None) -> str:
+    """Map one failed (or succeeded) run's evidence to a verdict."""
+    if rc == 0:
+        return SUCCESS
+    reasons = flightrec_reasons(flightrec_dir)
+    if rc == 124 or "stall" in reasons:
+        # the outer timeout or the in-process watchdog saw a hang: the
+        # classic shape of a peer preempted mid-collective — the
+        # survivors wedge, the watchdog dumps, the launcher kills
+        return STALL
+    if rc in _SIGNAL_RCS:
+        return PREEMPTION
+    missing = missing_worker_verdicts(verdict_path, nprocs)
+    if missing:
+        return PREEMPTION
+    if vanished_workers(flightrec_dir):
+        return PREEMPTION
+    return CRASH
+
+
+@dataclass(frozen=True)
+class Decision:
+    verdict: str
+    requeue: bool
+    backoff_s: float
+    reason: str
+
+    def shell_line(self) -> str:
+        return (f"VERDICT={self.verdict} REQUEUE={int(self.requeue)} "
+                f"BACKOFF_S={self.backoff_s:g} "
+                f"REASON='{self.reason}'")
+
+
+def backoff_s(attempt: int, *, base_s: float = BACKOFF_BASE_S,
+              max_s: float = BACKOFF_MAX_S) -> float:
+    """Exponential backoff for requeue attempt ``attempt`` (0-based):
+    base, 2x, 4x, ... capped — spot capacity that just vanished tends
+    to stay gone for a while; hammering the queue helps nobody."""
+    return min(max_s, base_s * (2.0 ** max(attempt, 0)))
+
+
+def decide(rc: int, *, attempt: int, max_requeues: int,
+           flightrec_dir: Optional[str] = None,
+           verdict_path: Optional[str] = None,
+           nprocs: Optional[int] = None,
+           base_s: float = BACKOFF_BASE_S,
+           max_s: float = BACKOFF_MAX_S) -> Decision:
+    verdict = classify(rc, flightrec_dir=flightrec_dir,
+                       verdict_path=verdict_path, nprocs=nprocs)
+    if verdict == SUCCESS:
+        return Decision(verdict, False, 0.0, "run succeeded")
+    if verdict == CRASH:
+        return Decision(
+            verdict, False, 0.0,
+            f"rc={rc} with every worker verdict present and no stall "
+            f"dump: deterministic failure — requeueing would loop on it")
+    if attempt >= max_requeues:
+        return Decision(
+            verdict, False, 0.0,
+            f"{verdict} but requeue budget exhausted "
+            f"({attempt}/{max_requeues})")
+    return Decision(
+        verdict, True, backoff_s(attempt, base_s=base_s, max_s=max_s),
+        f"{verdict} (rc={rc}), attempt {attempt + 1}/{max_requeues}: "
+        f"rerun with --resume auto from the last committed manifest")
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.elastic.policy",
+        description="requeue-or-stop verdict for a failed tpudist job")
+    p.add_argument("--rc", type=int, required=True,
+                   help="the training job's exit code")
+    p.add_argument("--attempt", type=int, default=0,
+                   help="0-based requeue attempts already consumed")
+    p.add_argument("--max-requeues", type=int,
+                   default=int(os.environ.get("MAX_REQUEUES", "0")),
+                   help="requeue budget (default $MAX_REQUEUES, else 0)")
+    p.add_argument("--flightrec-dir", type=str, default=None,
+                   help="collected flight-record artifacts to consult")
+    p.add_argument("--verdict", type=str, default=None,
+                   help="verdict file base path (per-worker files are "
+                        "<path>.worker<i>)")
+    p.add_argument("--nprocs", type=int, default=None,
+                   help="expected worker count for the vanished-worker "
+                        "check")
+    p.add_argument("--backoff-base-s", type=float,
+                   default=float(os.environ.get("TPUDIST_REQUEUE_BACKOFF_S",
+                                                BACKOFF_BASE_S)))
+    p.add_argument("--backoff-max-s", type=float, default=BACKOFF_MAX_S)
+    args = p.parse_args(argv)
+    d = decide(args.rc, attempt=args.attempt,
+               max_requeues=args.max_requeues,
+               flightrec_dir=args.flightrec_dir,
+               verdict_path=args.verdict, nprocs=args.nprocs,
+               base_s=args.backoff_base_s, max_s=args.backoff_max_s)
+    print(d.shell_line())
+    return 0 if d.requeue else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,247 @@
+"""Elastic resume: map a sharded manifest checkpoint onto the CURRENT mesh.
+
+The restore contract that makes restarts elastic rather than
+all-or-nothing (cf. the pjit/TPUv4 resharding primitive, PAPERS.md): the
+checkpoint was written by N processes as per-worker shard files plus
+slice indexes (tpudist.elastic.ckpt); the resumed run may come back on M
+processes with a different device count and a different sharding of
+every leaf. :func:`restore` reads the committed manifest, validates the
+step/epoch/data-cursor metadata against the resuming run's config, and
+assembles each leaf's locally-addressable slices directly from whichever
+saved shards intersect them (``jax.make_array_from_callback`` — each
+process touches only the bytes it will own). When a requested slice
+exactly equals a saved shard, the saved array is handed over zero-copy —
+the fast path for the common same-mesh restart, which is then
+bitwise-identical; a reshaped mesh gets the same values re-laid-out, so
+continuation is loss-correct (pinned in tests/test_elastic.py).
+
+The superstep/staging realignment needs no code here: the train loop's
+resume machinery already replays the epoch plan from ``(epoch,
+step_in_epoch)`` (the permutation is a pure function of (seed, epoch)
+and the realignment superstep masks the consumed prefix), and the epoch
+plan is computed from the CURRENT process topology — so a 4→2 reshard
+automatically re-cuts the same global batches across the new hosts.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from tpudist.elastic import ckpt as ckpt_mod
+
+
+class ResumeError(ValueError):
+    """A manifest exists but cannot drive this run's resume (structure,
+    shape, dtype, or data-cursor mismatch; missing shard coverage).
+    ``--resume auto`` converts this into a logged fresh start with a
+    ``fail`` resume_status; ``--resume latest`` lets it propagate."""
+
+
+def _shard_table(save_dir: str, manifest: Dict[str, Any]):
+    """Per-leaf shard lists from every worker's index:
+    ``name -> [(start, shape, npz, key), ...]`` plus the open npz
+    handles (lazy per-key loads; caller closes)."""
+    root = ckpt_mod.elastic_root(save_dir)
+    d = os.path.join(root, manifest["dir"])
+    table: Dict[str, List[Tuple]] = {}
+    handles = []
+    for i in range(int(manifest["process_count"])):
+        ipath = os.path.join(d, ckpt_mod.index_name(i))
+        if not os.path.exists(ipath):
+            raise ResumeError(
+                f"committed manifest step {manifest['step']} is missing "
+                f"worker {i}'s shard index ({ipath}) — was the steps/ "
+                f"directory pruned by hand?")
+        with open(ipath) as f:
+            idx = json.load(f)
+        npz = np.load(os.path.join(d, ckpt_mod.shards_name(i)))
+        handles.append(npz)
+        for name, rec in idx["leaves"].items():
+            rows = table.setdefault(name, [])
+            for sh in rec["shards"]:
+                rows.append((tuple(sh["start"]), tuple(sh["shape"]),
+                             npz, sh["key"]))
+    return table, handles
+
+
+def _as_dtype(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
+    """Reinterpret an npz-loaded shard as the template's dtype. The npy
+    format stores extension dtypes (ml_dtypes bfloat16 — the mixed-
+    precision mu/nu leaves) as raw void bytes (``|V2``) and loses the
+    type on read; a same-itemsize VIEW restores it bit-exactly. Other
+    dtypes were validated against the manifest already, so anything
+    else matching is a no-op."""
+    arr = np.asarray(arr)
+    if arr.dtype == dtype:
+        return arr
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == dtype.itemsize:
+        return arr.view(dtype)
+    return arr.astype(dtype)
+
+
+def _assemble(region: Tuple[Tuple[int, int], ...], shards, dtype
+              ) -> np.ndarray:
+    """Fill one requested slice of a leaf from the saved shards that
+    intersect it — the per-leaf slice-assembly reshard. Exact-match
+    shards return zero-copy; anything else is gathered piecewise with
+    full-coverage checking (a hole means the manifest does not actually
+    tile the array — refuse rather than resume from garbage)."""
+    shape = tuple(stop - start for start, stop in region)
+    for start, sshape, npz, key in shards:
+        if (tuple((s, s + d) for s, d in zip(start, sshape)) == region):
+            return _as_dtype(npz[key], dtype)
+    out = np.zeros(shape, dtype=dtype)
+    filled = 0
+    for start, sshape, npz, key in shards:
+        # intersection of [start, start+sshape) with the region
+        lo = [max(s, r0) for s, (r0, _) in zip(start, region)]
+        hi = [min(s + d, r1) for s, d, (_, r1)
+              in zip(start, sshape, region)]
+        if any(l >= h for l, h in zip(lo, hi)):
+            continue
+        src = _as_dtype(npz[key], dtype)[
+            tuple(slice(l - s, h - s)
+                  for l, h, s in zip(lo, hi, start))]
+        out[tuple(slice(l - r0, h - r0)
+                  for l, h, (r0, _) in zip(lo, hi, region))] = src
+        filled += int(np.prod([h - l for l, h in zip(lo, hi)],
+                              dtype=np.int64))
+    size = int(np.prod(shape, dtype=np.int64))
+    if filled != size:
+        raise ResumeError(
+            f"saved shards cover {filled} of {size} elements of region "
+            f"{region} — manifest does not tile the leaf (overlap or "
+            f"hole); refusing to resume from a torn layout")
+    return out
+
+
+def validate_run_meta(manifest: Dict[str, Any],
+                      expect: Optional[Dict[str, Any]]) -> None:
+    """The data-cursor check: resuming with a different seed or global
+    batch size silently replays a DIFFERENT epoch permutation, so the
+    'resumed' trajectory would be unrelated to the one checkpointed —
+    refuse loudly instead. Only keys present in both are compared (the
+    manifest's ``run`` block is the writer's claim; an older manifest
+    without it stays restorable)."""
+    saved = manifest.get("run") or {}
+    if not expect:
+        return
+    bad = {k: (saved[k], v) for k, v in expect.items()
+           if k in saved and saved[k] != v}
+    if bad:
+        raise ResumeError(
+            "manifest data cursor disagrees with this run's config: "
+            + ", ".join(f"{k}: saved {s!r} vs current {c!r}"
+                        for k, (s, c) in bad.items())
+            + " — the epoch permutation would not replay; pass a "
+              "matching --seed/--train-batch-size or start fresh")
+
+
+def restore(save_dir: str, template: Any, *,
+            run_meta: Optional[Dict[str, Any]] = None
+            ) -> Optional[Tuple[Any, int, int]]:
+    """Restore the committed sharded manifest onto ``template``'s mesh
+    layout as ``(state, epoch, step_in_epoch)``, or None when no
+    manifest was ever committed. ``template`` (the concretely-sharded
+    live TrainState) pins the treedef, shapes, dtypes and target
+    shardings; the saved shards may come from any process/device
+    count."""
+    import jax
+
+    manifest = ckpt_mod.latest_manifest(save_dir)
+    if manifest is None:
+        return None
+    validate_run_meta(manifest, run_meta)
+    table, handles = _shard_table(save_dir, manifest)
+    try:
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template)
+        out_leaves = []
+        for path, leaf in flat:
+            name = jax.tree_util.keystr(path)
+            saved_meta = (manifest.get("leaves") or {}).get(name)
+            shards = table.get(name)
+            if not shards:
+                raise ResumeError(
+                    f"manifest has no shards for leaf {name} — the "
+                    f"model/optimizer structure changed since the "
+                    f"checkpoint was written")
+            shape = tuple(getattr(leaf, "shape", ()))
+            dtype = np.dtype(getattr(leaf, "dtype", np.float32))
+            if saved_meta is not None:
+                if tuple(saved_meta["shape"]) != shape:
+                    raise ResumeError(
+                        f"leaf {name}: saved shape "
+                        f"{tuple(saved_meta['shape'])} != current "
+                        f"{shape} — a reshard can change the LAYOUT, "
+                        f"never the global shape")
+                if np.dtype(saved_meta["dtype"]) != dtype:
+                    raise ResumeError(
+                        f"leaf {name}: saved dtype {saved_meta['dtype']}"
+                        f" != current {dtype}")
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None:
+                full = tuple((0, d) for d in shape)
+                out_leaves.append(_assemble(full, shards, dtype))
+                continue
+            from tpudist.parallel.sharding import norm_shard_index
+            out_leaves.append(jax.make_array_from_callback(
+                shape, sharding,
+                lambda idx, _sh=shards, _shape=shape, _dt=dtype:
+                    _assemble(norm_shard_index(idx, _shape), _sh, _dt)))
+        state = jax.tree_util.tree_unflatten(treedef, out_leaves)
+    finally:
+        for h in handles:
+            try:
+                h.close()
+            except Exception:
+                pass
+    return state, int(manifest["epoch"]), int(manifest["step_in_epoch"])
+
+
+def restore_for_resume(save_dir: str, template: Any, *,
+                       run_meta: Optional[Dict[str, Any]] = None
+                       ) -> Optional[Tuple[Any, int, int, str]]:
+    """The train loop's one resume entry. The elastic tree and orbax
+    step dirs can coexist in one ``--save-dir`` (e.g. a run switched
+    ``--ckpt-mode``), so the pick is NEWEST-WINS by checkpoint key —
+    resuming an old manifest past newer orbax steps would silently
+    retrain the difference. A manifest that exists but cannot restore
+    (torn tree, data-cursor mismatch) falls back to orbax when orbax
+    has anything; only when no fallback exists does the manifest's
+    error propagate (``--resume latest`` then raises, ``auto``
+    degrades to a flagged fresh start). Returns ``(state, epoch,
+    step_in_epoch, source)`` with source in ``{"manifest", "orbax"}``,
+    or None for a fresh start."""
+    from tpudist import checkpoint as ckpt_lib
+
+    manifest = ckpt_mod.latest_manifest(save_dir)
+    orbax_step = ckpt_lib.latest_step(save_dir)
+    manifest_err: Optional[Exception] = None
+    if manifest is not None and (orbax_step is None
+                                 or int(manifest["step"]) >= orbax_step):
+        try:
+            out = restore(save_dir, template, run_meta=run_meta)
+            if out is not None:
+                return (*out, "manifest")
+        except Exception as e:
+            if orbax_step is None:
+                raise
+            manifest_err = e
+            import sys
+            print(f"tpudist: elastic manifest restore failed ({e!r}); "
+                  f"falling back to the orbax checkpoint at step "
+                  f"{orbax_step}", file=sys.stderr, flush=True)
+    full = ckpt_lib.restore_latest_full(save_dir, template)
+    if full is not None:
+        return (*full, "orbax")
+    if manifest is not None and manifest_err is None:
+        # manifest is older than an orbax key that then failed to
+        # restore (or vanished between peek and read): still usable
+        out = restore(save_dir, template, run_meta=run_meta)
+        if out is not None:
+            return (*out, "manifest")
+    return None

@@ -506,6 +506,8 @@ def build_report(metrics: List[Dict[str, Any]],
     timing = timings[-1] if timings else None
     epochs = [r for r in metrics if r.get("kind") == "epoch"]
     tunes = [r for r in metrics if r.get("kind") == "tune"]
+    resumes = [r for r in metrics if r.get("kind") == "resume"]
+    resume = resumes[-1] if resumes else None
 
     regression = regression_section(timing, baseline, regress_min)
     stragglers = straggler_section(hosts, metrics)
@@ -541,6 +543,13 @@ def build_report(metrics: List[Dict[str, Any]],
             "comm_status": devtime["comm_status"],
             "trace_status": (timing.get("trace_status")
                              if timing else None),
+            # elastic-resume slice of the header (tpudist.elastic): did
+            # this run continue a preempted one, from where, at what cost
+            "resume_status": ((resume or {}).get("status")
+                              or (timing or {}).get("resume_status")),
+            "resumed_from_step": (resume or {}).get("resumed_from_step"),
+            "resume_steps_lost": (resume or {}).get("steps_lost"),
+            "requeue_attempt": (resume or {}).get("requeue_attempt"),
         },
         "trace": {
             "hosts": trace_doc.get("metadata", {}).get("hosts", 1),
@@ -582,6 +591,22 @@ def to_markdown(report: Dict[str, Any]) -> str:
                   + (f"{warm:.3f}s" if warm is not None else "—"),
                   f"- epochs: {run['epochs']}, final avg loss "
                   f"{run.get('final_avg_loss')}", ""]
+    if run.get("resume_status") not in (None, UNGATEABLE):
+        lost = run.get("resume_steps_lost")
+        req = run.get("requeue_attempt")
+        req_note = f", requeue attempt {req}" if req else ""
+        if run["resume_status"] == FAIL:
+            # a failed restore means the run started FRESH — saying
+            # "continued from step 0" would claim a continuation that
+            # never happened
+            lines += [f"- resume: **fail** — restore errored, run "
+                      f"started fresh{req_note}", ""]
+        else:
+            lines += [f"- resume: **{run['resume_status']}** — continued "
+                      f"from global step {run.get('resumed_from_step')}"
+                      + (f", ~{lost} step(s) lost to the preemption"
+                         if lost is not None else "")
+                      + req_note, ""]
     reg = r["regression"]
     if reg["status"] != UNGATEABLE:
         lines += [f"- regression gate: {reg['steps_per_sec']} vs baseline "

@@ -155,6 +155,52 @@ def plan_slabs(n_steps: int, k: int, step_bytes: int,
                     budget_bytes, streamed=True)
 
 
+def norm_shard_index(idx, shape) -> tuple:
+    """A sharding index (tuple of slices, as produced by
+    ``Sharding.devices_indices_map`` / ``Shard.index``) normalised to
+    concrete per-dim ``(start, stop)`` pairs — hashable, json-able, and
+    mesh-agnostic, which is what lets the elastic checkpoint layout
+    (tpudist.elastic.ckpt) describe a shard independently of the mesh
+    that produced it."""
+    out = []
+    for sl, dim in zip(idx, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = dim if sl.stop is None else int(sl.stop)
+        out.append((start, stop))
+    return tuple(out)
+
+
+def owned_shard_spans(leaf, process_index: int):
+    """The distinct shards of ``leaf`` that process ``process_index``
+    OWNS for writing: its addressable shards, deduped by slice span,
+    minus any span also held by a lower-ranked process — a replicated
+    leaf is written exactly once pod-wide, by the lowest owner (pure-DP
+    params must not cost process_count copies on disk). Returns
+    ``[(span, shard_data), ...]`` with span per :func:`norm_shard_index`.
+    Host-side leaves with no sharding are treated as replicated."""
+    import numpy as np
+
+    sharding = getattr(leaf, "sharding", None)
+    shape = tuple(getattr(leaf, "shape", ()))
+    if sharding is None or not hasattr(leaf, "addressable_shards"):
+        if process_index != 0:
+            return []
+        return [(tuple((0, d) for d in shape), np.asarray(leaf))]
+    owner: dict = {}
+    for dev, idx in sharding.devices_indices_map(shape).items():
+        span = norm_shard_index(idx, shape)
+        p = int(getattr(dev, "process_index", 0))
+        owner[span] = min(owner.get(span, p), p)
+    out, seen = [], set()
+    for sh in leaf.addressable_shards:
+        span = norm_shard_index(sh.index, shape)
+        if span in seen or owner.get(span) != process_index:
+            continue
+        seen.add(span)
+        out.append((span, np.asarray(sh.data)))
+    return out
+
+
 def batch_sharding(mesh: Mesh, tree):
     return jax.tree.map(
         lambda x: NamedSharding(mesh, batch_spec(x.ndim)), tree)

@@ -41,6 +41,39 @@ from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
 
 
+_KILL_SPEC: Optional[tuple] = None
+
+
+def _maybe_test_kill(epoch: int, step: int) -> None:
+    """Scripted preemption for drills and CI (``TPUDIST_TEST_KILL=
+    "<epoch>:<step>[:<rank>]"``): once the given epoch reaches the given
+    step-in-epoch, the matching rank (omitted/-1 = every rank — a spot
+    preemption takes the whole slice) dies via ``os._exit`` — no
+    ``finally`` blocks, no verdict write, no ckpt drain, exactly the
+    death a preemption reaper delivers. The elastic acceptance lane
+    kills a run this way and asserts the requeued ``--resume auto`` run
+    continues bitwise-identically from the last committed manifest.
+    Parsed once per process (the drills always run in subprocesses —
+    an in-process kill would take the test harness with it)."""
+    global _KILL_SPEC
+    if _KILL_SPEC is None:
+        raw = os.environ.get("TPUDIST_TEST_KILL", "")
+        if raw:
+            parts = raw.split(":")
+            _KILL_SPEC = (int(parts[0]), int(parts[1]),
+                          int(parts[2]) if len(parts) > 2 else -1)
+        else:
+            _KILL_SPEC = ()
+    if not _KILL_SPEC:
+        return
+    ke, ks, kr = _KILL_SPEC
+    if epoch == ke and step >= ks and (kr < 0
+                                       or kr == jax.process_index()):
+        print(f"tpudist: TEST KILL (preemption drill) at epoch {epoch} "
+              f"step {step}", flush=True)
+        os._exit(113)
+
+
 def run(cfg: TrainConfig) -> float:
     """Train per config; returns the last epoch's average loss.
 
@@ -180,14 +213,73 @@ def run(cfg: TrainConfig) -> float:
                 cfg.model.vocab_size, cfg.data.seed + 1),)
         eval_fn = engine_lib.make_eval_fn(cfg, mesh)
 
+    # elastic resume (tpudist.elastic.resume): prefer the committed
+    # sharded manifest, fall back to orbax; ``--resume auto`` (what the
+    # launcher's requeue loop passes) degrades a failed restore to a
+    # flagged fresh start instead of crash-looping. The restored
+    # (epoch, step_in_epoch) feeds the existing superstep realignment,
+    # which replays the (seed, epoch)-pure batch order on the CURRENT
+    # process topology — same mesh resumes bitwise, a reshaped one
+    # loss-correct.
     start_epoch, start_step_in_epoch = 0, 0
-    if cfg.resume:
-        with trace_lib.span("resume_restore", cat="ckpt"):
-            restored = ckpt_lib.restore_latest_full(cfg.save_dir, state)
+    resume_mode = config_lib.resolve_resume(cfg)
+    requeue_attempt = config_lib.resolve_requeue_attempt(cfg)
+    resume_verdict = verdict_lib.UNGATEABLE
+    if resume_mode:
+        from tpudist.elastic import resume as elastic_resume
+        restored, resume_src, resume_err = None, None, None
+        with trace_lib.span("resume_restore", cat="ckpt",
+                            mode=resume_mode):
+            try:
+                restored = elastic_resume.restore_for_resume(
+                    cfg.save_dir, state,
+                    run_meta={"seed": cfg.seed,
+                              "batch_size": cfg.batch_size,
+                              "model": cfg.model.name})
+            except Exception as e:
+                if resume_mode != "auto":
+                    raise
+                resume_err = e
         if restored is not None:
-            state, start_epoch, start_step_in_epoch = restored
+            state, start_epoch, start_step_in_epoch, resume_src = restored
+        resume_verdict = verdict_lib.resume_status(
+            True, restored is not None, error=resume_err is not None)
+        # steps lost to the preemption: the dead run's heartbeat beacon
+        # (obs.heartbeat, atomic — survives any kill) recorded how far
+        # training had actually advanced past the committed checkpoint
+        steps_lost = None
+        if restored is not None:
+            import json as _json
+            beacon = os.path.join(
+                config_lib.resolve_obs(cfg)[1],
+                f"heartbeat.worker{ctx.process_index}")
+            try:
+                with open(beacon) as f:
+                    b = _json.load(f)
+                if (b.get("epoch") == start_epoch
+                        and isinstance(b.get("step"), int)):
+                    steps_lost = max(0, b["step"] - start_step_in_epoch)
+            except Exception:
+                pass
+        metrics.log(kind="resume", status=resume_verdict,
+                    source=resume_src,
+                    epoch=start_epoch, step_in_epoch=start_step_in_epoch,
+                    resumed_from_step=int(state.step),
+                    steps_lost=steps_lost,
+                    requeue_attempt=requeue_attempt,
+                    error=repr(resume_err) if resume_err else None)
+        if restored is not None:
             log0(f"Resumed at epoch {start_epoch}, step "
                  f"{start_step_in_epoch} (global step {int(state.step)}).")
+            log0(f"tpudist: resume {resume_verdict} ({resume_src}): "
+                 f"from step {int(state.step)}"
+                 + (f", ~{steps_lost} step(s) lost"
+                    if steps_lost is not None else "")
+                 + (f", requeue attempt {requeue_attempt}"
+                    if requeue_attempt else ""))
+        elif resume_err is not None:
+            log0(f"tpudist: resume {resume_verdict}: restore failed, "
+                 f"starting fresh ({resume_err!r})")
 
     timer = StepTimer()
     last_avg = float("nan")
@@ -209,10 +301,22 @@ def run(cfg: TrainConfig) -> float:
         stall_hook=(win.emergency_stop if win is not None else None))
 
     # one manager for the whole run: async saves overlap the next epoch's
-    # steps (the old save-per-call shape implied a synchronous drain)
-    with trace_lib.span("ckpt_open", cat="ckpt"):
-        ckpt = ckpt_lib.Checkpointer(cfg.save_dir,
-                                     use_async=not cfg.ckpt_sync)
+    # steps (the old save-per-call shape implied a synchronous drain).
+    # --ckpt-mode sharded swaps in the elastic per-worker-shard layout
+    # (tpudist.elastic.ckpt) behind the same save/wait/close surface.
+    ckpt_mode = config_lib.resolve_ckpt_mode(cfg)
+    with trace_lib.span("ckpt_open", cat="ckpt", mode=ckpt_mode):
+        if ckpt_mode == "sharded":
+            from tpudist.elastic import ckpt as elastic_ckpt
+            ckpt = elastic_ckpt.ShardedCheckpointer(
+                cfg.save_dir, process_index=ctx.process_index,
+                process_count=ctx.process_count,
+                use_async=not cfg.ckpt_sync,
+                run_meta={"seed": cfg.seed, "batch_size": cfg.batch_size,
+                          "model": cfg.model.name})
+        else:
+            ckpt = ckpt_lib.Checkpointer(cfg.save_dir,
+                                         use_async=not cfg.ckpt_sync)
 
     import contextlib
     # EVERY worker captures the profiler trace, into per-process
@@ -369,6 +473,7 @@ def run(cfg: TrainConfig) -> float:
                 **staging.split(), staging_overlap_fraction=overlap,
                 staging_status=staging_verdict,
                 tuning_status=tuning_status,
+                resume_status=resume_verdict,
                 comm_status=devtime_status,
                 trace_status=trace_verdict,
                 trace_spans=(trace_summary or {}).get("spans"),
@@ -491,6 +596,7 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                 # the beacon's step stops advancing with it)
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=end)
+            _maybe_test_kill(epoch, end)
             if not dispatched:
                 dispatched = True
                 if timer.warming:
@@ -593,6 +699,7 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             if observer is not None:
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=i + 1)
+            _maybe_test_kill(epoch, i + 1)
             if i == first and timer.warming:
                 # fence the first step alone so the timer's warmup absorbs
                 # exactly the trace+compile cost, not a whole fence group —

@@ -152,6 +152,26 @@ def trace_status(enabled: bool, spans: int, dropped: int,
     return SUCCESS
 
 
+def resume_status(requested: bool, restored: bool,
+                  error: bool = False) -> str:
+    """Three-valued elastic-resume verdict (tpudist.elastic) for the run
+    log + ``kind=resume``/``kind=timing`` records: UNGATEABLE when no
+    resume was requested OR nothing existed to restore (a fresh start by
+    request is not a failure — the launcher's first attempt always runs
+    ``--resume auto`` against an empty save dir); SUCCESS when a
+    committed checkpoint was restored and training continued from it;
+    FAIL when a restore was ATTEMPTED and errored — under ``--resume
+    auto`` the run degrades to a flagged fresh start (a requeued job
+    must make progress, not crash-loop), and this status is how the
+    artifact stream distinguishes that from a clean resume. Advisory,
+    like the staging/straggler gates."""
+    if not requested:
+        return UNGATEABLE
+    if error:
+        return FAIL
+    return SUCCESS if restored else UNGATEABLE
+
+
 def comm_status(exposed_frac, max_frac: float | None = None) -> str:
     """Three-valued exposed-communication verdict (tpudist.obs.devtime,
     ``--profile-window`` capture): UNGATEABLE with no device window
