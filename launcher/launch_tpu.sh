@@ -40,6 +40,19 @@
 #                       plus the offline run report
 #                       (run_report.json/.md, python -m
 #                       tpudist.obs.report) are pulled instead.
+#   RUN_ID              correlation id stamped into every artifact
+#                       (metrics records, traces, flight records, ckpt
+#                       meta, live status) — generated here when unset,
+#                       and held constant across requeue attempts so
+#                       the attempts stay correlatable
+#   LIVE_PORT           when set, turn on the live telemetry bus
+#                       (tpudist.obs.live): the coordinator aggregates
+#                       every worker's stream, runs the on-line alert
+#                       engine (same thresholds as the exit verdict —
+#                       tpudist.rules), serves Prometheus /metrics on
+#                       this port, and maintains live_status.json in
+#                       OBS_DIR (collected with the other artifacts;
+#                       tail it with python -m tpudist.obs.live tail)
 #   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
 #                       (debugging a slice with a known-red kernel)
 #   SKIP_TESTS_TPU=1    bypass the on-chip pytest lane (tests_tpu/)
@@ -81,6 +94,17 @@ SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
 MAX_REQUEUES="${MAX_REQUEUES:-0}"
 REQUEUE_BACKOFF_S="${REQUEUE_BACKOFF_S:-10}"
+# ONE run id for the whole launch, every attempt included: the workload
+# stamps it into every artifact (tpudist.obs.live.resolve_run_id
+# prefers $TPUDIST_RUN_ID), so a requeue loop's attempts correlate
+RUN_ID="${RUN_ID:-$(date +%Y%m%d%H%M%S)-$$}"
+LIVE_PORT="${LIVE_PORT:-}"
+# live env shipped to every worker (empty strings = off; the workload's
+# resolve_live treats "" as unset)
+LIVE_ENV="TPUDIST_RUN_ID=$RUN_ID"
+if [ -n "$LIVE_PORT" ]; then
+  LIVE_ENV+=" TPUDIST_LIVE=on TPUDIST_LIVE_PORT=$LIVE_PORT"
+fi
 # the requeue policy runs on THIS host (it is stdlib-only python); the
 # repo root sits one level above this script
 SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
@@ -156,13 +180,35 @@ case "$ACCELERATOR_TYPE" in
   *) EXPECTED_CHIPS=$((SUFFIX / 2)) ;;
 esac
 
+# ---- live-telemetry endpoint ----------------------------------------------
+resolve_live_endpoint() {
+  # workers on other hosts reach the coordinator's aggregator by its
+  # internal IP; the ingest listener sits one port above the Prometheus
+  # exporter. Re-resolved after any re-provisioning (new slice, new IP).
+  [ -n "$LIVE_PORT" ] || return 0
+  local ip
+  ip=$(gcloud compute tpus tpu-vm describe "$TPU_NAME" \
+    --zone "$ZONE" --project "$PROJECT" \
+    --format='value(networkEndpoints[0].ipAddress)' 2>/dev/null || true)
+  LIVE_ENV="TPUDIST_RUN_ID=$RUN_ID TPUDIST_LIVE=on \
+TPUDIST_LIVE_PORT=$LIVE_PORT"
+  if [ -n "$ip" ]; then
+    LIVE_ENV+=" TPUDIST_LIVE_ENDPOINT=tcp://$ip:$((LIVE_PORT + 1))"
+  fi
+}
+
 # ---- workload delivery -----------------------------------------------------
 deliver_workload() {
+  resolve_live_endpoint
   if [ -n "${IMAGE:-}" ]; then
     # /tmp is mounted so the sweep's JSONL artifact lands on the host VM;
-    # the per-worker verdict path (below) rides the same mount
+    # the per-worker verdict path (below) rides the same mount. The live
+    # env enters the container via -e (inline assignments on the ssh
+    # command line do not cross the docker boundary).
+    local live_flags=""
+    for kv in $LIVE_ENV; do live_flags+=" -e $kv"; done
     RUN_PREFIX="sudo docker run --rm --privileged --network host -v /tmp:/tmp \
-      -e TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt $IMAGE"
+      -e TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt$live_flags $IMAGE"
     tpu_ssh all "sudo docker pull $IMAGE"
     TESTS_TPU_PATH="tests_tpu"     # baked into the image at /workspace
   else
@@ -329,9 +375,12 @@ while :; do
   # the collection below ships both — the policy's vanished-worker
   # inference (beacon present, verdict absent => preempted) keys off
   # exactly this pairing. (Containerised runs get the env via
-  # RUN_PREFIX's -e; OBS_DIR rides the /tmp mount.)
+  # RUN_PREFIX's -e; OBS_DIR rides the /tmp mount.) $LIVE_ENV rides the
+  # same inline-assignment path for bare runs: the run id (and, when
+  # LIVE_PORT is set, the live-bus switches + coordinator endpoint)
+  # reaches every worker's environment.
   set +e
-  tpu_ssh all "TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt \
+  tpu_ssh all "TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt $LIVE_ENV \
     timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
     --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$RESUME_FLAGS$EXTRA_Q"
   RC=$?
@@ -408,6 +457,18 @@ gcloud compute tpus tpu-vm scp \
 gcloud compute tpus tpu-vm scp --recurse "$TPU_NAME:$OBS_DIR/profile" \
   flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
   --worker=0 2>/dev/null || true
+# live-telemetry artifacts (coordinator-only: the aggregator runs
+# there): the final live_status.json plus the append-only alert
+# transition log. The report CLI above already folded them into its
+# Alerts section (auto-discovered in --run-dir); alerts.jsonl only
+# exists when something fired, so each pull is its own best-effort.
+if [ -n "$LIVE_PORT" ]; then
+  for f in live_status.json alerts.jsonl; do
+    gcloud compute tpus tpu-vm scp "$TPU_NAME:$OBS_DIR/$f" \
+      flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
+      --worker=0 2>/dev/null || true
+  done
+fi
 ls -l flightrec_artifacts/ 2>/dev/null || true
 
 # ---- gated bandwidth sweep (while the slice is alive) ----------------------

@@ -421,3 +421,38 @@ def test_image_path_skips_install_uses_docker(stub_env):
     calls = (stub / "calls.log").read_text()
     assert "docker pull ghcr.io/x/y:ci-1" in calls
     assert "pip3 install" not in calls
+
+
+def test_live_env_reaches_train_and_artifacts_pulled(stub_env):
+    """LIVE_PORT turns the bus on pod-wide: the train command line
+    carries the live env (inline assignments — the bare path's only
+    channel into the workers' environment) with ONE run id, and the
+    success path pulls live_status.json + alerts.jsonl off the
+    coordinator alongside the trace/report artifacts."""
+    env, stub = stub_env
+    env.update(LIVE_PORT="9109", RUN_ID="r-live-1")
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    train = _train_lines(stub)[0]
+    assert "TPUDIST_RUN_ID=r-live-1" in train
+    assert "TPUDIST_LIVE=on" in train
+    assert "TPUDIST_LIVE_PORT=9109" in train
+    calls = (stub / "calls.log").read_text().splitlines()
+    for f in ("live_status.json", "alerts.jsonl"):
+        pulls = [ln for ln in calls if "scp" in ln and f in ln]
+        assert pulls and "--worker=0" in pulls[0], f
+
+
+def test_live_off_by_default_but_run_id_always_stamped(stub_env):
+    """Without LIVE_PORT no live switches ride the train command (the
+    bus stays off — it opens sockets), but the run id STILL ships: the
+    correlation satellite holds for every launch, live or not."""
+    env, stub = stub_env
+    env["RUN_ID"] = "r-plain-1"
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    train = _train_lines(stub)[0]
+    assert "TPUDIST_RUN_ID=r-plain-1" in train
+    assert "TPUDIST_LIVE=on" not in train
+    calls = (stub / "calls.log").read_text()
+    assert "live_status.json" not in calls

@@ -1,0 +1,1037 @@
+"""Live pod telemetry (tpudist.obs.live + tpudist.obs.alerts +
+tpudist.rules): wire format, drop-not-block emitter, scripted
+aggregation windows, the on-line alert engine's parity with the at-exit
+verdict gates (the shared-rules refactor, pinned by diffing the two
+consumers), Prometheus exposition golden output, the tail CLI, and the
+train-CLI integration (bitwise live-on/off parity, zero construction
+when disabled, run_id stamping across every artifact)."""
+
+import json
+import os
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpudist import config as config_lib
+from tpudist import rules as rules_lib
+from tpudist import train as train_mod
+from tpudist import verdict as verdict_lib
+from tpudist.obs import alerts as alerts_lib
+from tpudist.obs import devtime as devtime_lib
+from tpudist.obs import live as live_lib
+from tpudist.obs import report as report_lib
+from tpudist.obs.heartbeat import FlightRecorder
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+class FakeEmitter:
+    def __init__(self):
+        self.recs = []
+
+    def emit(self, rec):
+        self.recs.append(dict(rec))
+
+
+def make_agg(tmp_path, **kw):
+    kw.setdefault("start_ticker", False)
+    clk = kw.pop("clk", None) or FakeClock()
+    kw.setdefault("clock", clk)
+    kw.setdefault("wall", clk)
+    agg = live_lib.LiveAggregator(out_dir=str(tmp_path), **kw)
+    return agg, clk
+
+
+# ------------------------------------------------------------ rules table
+
+
+def test_rules_table_names_and_alert_subset():
+    names = {t.name for t in rules_lib.THRESHOLDS}
+    assert names == {"straggler", "staging", "comm", "regress", "stall",
+                     "trace_drop"}
+    # every rule but the artifact-quality one is a live alert
+    assert {t.name for t in rules_lib.ALERT_RULES} == names - {
+        "trace_drop"}
+
+
+def test_rules_resolve_env_override(monkeypatch):
+    assert rules_lib.resolve("staging") == rules_lib.STAGING_OVERLAP_MIN
+    monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "0.9")
+    assert rules_lib.resolve("staging") == 0.9
+    # malformed env reads as the default, never a startup crash
+    monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "not-a-float")
+    assert rules_lib.resolve("staging") == rules_lib.STAGING_OVERLAP_MIN
+
+
+def test_rules_breached_sense():
+    # max-sense: breach strictly above
+    assert rules_lib.breached("comm", 0.3)
+    assert not rules_lib.breached("comm", 0.25)
+    # min-sense: breach strictly below
+    assert rules_lib.breached("staging", 0.4)
+    assert not rules_lib.breached("staging", 0.5)
+    # no measurement never breaches (ungateable, not bad)
+    assert not rules_lib.breached("comm", None)
+
+
+def test_rules_unknown_name_raises():
+    with pytest.raises(KeyError):
+        rules_lib.get("no_such_rule")
+    with pytest.raises(KeyError):
+        rules_lib.breached("no_such_rule", 1.0)
+
+
+def test_exit_graders_share_the_rules_constants():
+    """The shared-rules refactor pin: every at-exit grader's module
+    constant IS the rules-table value — the two threshold sets cannot
+    drift because there is only one set."""
+    assert verdict_lib.STAGING_OVERLAP_MIN is rules_lib.STAGING_OVERLAP_MIN
+    assert verdict_lib.STRAGGLER_FACTOR is rules_lib.STRAGGLER_FACTOR
+    assert verdict_lib.TRACE_DROP_MAX is rules_lib.TRACE_DROP_MAX
+    assert devtime_lib.COMM_EXPOSED_MAX is rules_lib.COMM_EXPOSED_MAX
+    assert report_lib.REGRESS_MIN_FRACTION is rules_lib.REGRESS_MIN_FRACTION
+    assert config_lib.OBS_STALL_TIMEOUT_S is rules_lib.STALL_TIMEOUT_S
+
+
+def test_exit_graders_honor_the_same_env_knobs(monkeypatch):
+    """Functional half of the parity pin: moving a rule's env knob moves
+    BOTH the at-exit grader and the live engine, through the same
+    resolve() call."""
+    monkeypatch.setenv("TPUDIST_STAGING_OVERLAP_MIN", "0.95")
+    assert verdict_lib.staging_status(True, 0.9) == verdict_lib.FAIL
+    assert rules_lib.breached("staging", 0.9)
+    monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "0.01")
+    assert devtime_lib.comm_status(0.02) == verdict_lib.FAIL
+    assert rules_lib.breached("comm", 0.02)
+    monkeypatch.setenv("TPUDIST_STRAGGLER_FACTOR", "3.0")
+    # ratio 2x: clear under the 3.0 override in both consumers
+    assert verdict_lib.straggler_status([0.1, 0.2]) == verdict_lib.SUCCESS
+    assert not rules_lib.breached("straggler", 2.0)
+
+
+# ----------------------------------------------------------- alert engine
+
+
+def test_alert_engine_fire_update_resolve():
+    clk = FakeClock(100.0)
+    eng = alerts_lib.AlertEngine(clock=clk)
+    ev = eng.observe("comm", 0.5, step=3)
+    assert ev and ev["state"] == alerts_lib.FIRING
+    assert ev["value"] == 0.5 and ev["first_step"] == 3
+    assert ev["threshold"] == rules_lib.COMM_EXPOSED_MAX
+    clk.t = 110.0
+    # still breaching: no new event, duration/value update in place
+    assert eng.observe("comm", 0.6, step=5) is None
+    (a,) = eng.firing()
+    assert a["value"] == 0.6 and a["duration_s"] == 10.0
+    assert a["first_step"] == 3 and a["last_step"] == 5
+    clk.t = 120.0
+    ev = eng.observe("comm", 0.1, step=7)
+    assert ev and ev["state"] == alerts_lib.RESOLVED
+    assert ev["duration_s"] == 20.0
+    assert eng.firing() == []
+    # history keeps the full lifecycle, events counted both transitions
+    assert eng.events == 2
+    assert eng.snapshot()["history"][0]["state"] == alerts_lib.RESOLVED
+
+
+def test_alert_engine_none_never_fires_or_resolves():
+    eng = alerts_lib.AlertEngine(clock=FakeClock())
+    assert eng.observe("comm", None) is None
+    assert eng.firing() == []
+    eng.observe("comm", 0.9)
+    # a gap in the signal is not evidence of recovery
+    assert eng.observe("comm", None) is None
+    assert len(eng.firing()) == 1
+
+
+def test_alert_engine_per_host_keys_independent():
+    eng = alerts_lib.AlertEngine(clock=FakeClock())
+    eng.observe("stall", 400.0, host=0)
+    eng.observe("stall", 400.0, host=1)
+    assert len(eng.firing()) == 2
+    eng.observe("stall", 0.0, host=0)
+    (a,) = eng.firing()
+    assert a["host"] == 1
+
+
+def test_alert_engine_threshold_override():
+    eng = alerts_lib.AlertEngine(clock=FakeClock())
+    # 10s is way under the 300s default — only the explicit per-run
+    # window (the --stall-timeout-s flag path) makes it a breach
+    assert eng.observe("stall", 10.0, threshold=5.0) is not None
+    eng2 = alerts_lib.AlertEngine(clock=FakeClock())
+    assert eng2.observe("stall", 10.0) is None
+
+
+def test_alert_engine_on_event_exception_swallowed():
+    def boom(rec):
+        raise RuntimeError("observer crashed")
+    eng = alerts_lib.AlertEngine(on_event=boom, clock=FakeClock())
+    ev = eng.observe("comm", 0.9)     # must not raise
+    assert ev["state"] == alerts_lib.FIRING
+
+
+# ------------------------------------------------------------ wire format
+
+
+def test_frame_roundtrip_and_multi_frame():
+    recs = [{"kind": "step", "step": i, "loss": 0.5} for i in range(3)]
+    blob = b"".join(live_lib.encode_frame(r) for r in recs)
+    dec = live_lib.FrameDecoder()
+    assert dec.feed(blob) == recs
+    assert dec.bad == 0
+
+
+def test_frame_decoder_partial_feeds():
+    rec = {"kind": "heartbeat", "process_index": 2, "step": 41}
+    blob = live_lib.encode_frame(rec)
+    dec = live_lib.FrameDecoder()
+    out = []
+    for i in range(len(blob)):       # one byte at a time
+        out += dec.feed(blob[i:i + 1])
+    assert out == [rec] and dec.bad == 0
+
+
+def test_frame_decoder_corrupt_length_resyncs():
+    dec = live_lib.FrameDecoder()
+    bogus = (live_lib.MAX_FRAME_BYTES + 1).to_bytes(4, "big") + b"junk"
+    assert dec.feed(bogus) == []
+    assert dec.bad == 1
+    # the decoder recovered: a following good frame still parses
+    rec = {"kind": "step", "step": 1}
+    assert dec.feed(live_lib.encode_frame(rec)) == [rec]
+
+
+def test_frame_decoder_bad_payloads_counted():
+    dec = live_lib.FrameDecoder()
+    raw = b"not json"
+    assert dec.feed(len(raw).to_bytes(4, "big") + raw) == []
+    assert dec.bad == 1
+    raw = b"[1, 2]"                   # parses but is not a record
+    assert dec.feed(len(raw).to_bytes(4, "big") + raw) == []
+    assert dec.bad == 2
+
+
+def test_parse_endpoint():
+    assert live_lib.parse_endpoint("host:9") == ("tcp", ("host", 9))
+    assert live_lib.parse_endpoint("tcp://h:80") == ("tcp", ("h", 80))
+    assert live_lib.parse_endpoint("udp://h:80") == ("udp", ("h", 80))
+    assert live_lib.parse_endpoint(":80") == ("tcp", ("127.0.0.1", 80))
+    with pytest.raises(ValueError):
+        live_lib.parse_endpoint("http://h:80")
+    with pytest.raises(ValueError):
+        live_lib.parse_endpoint("no-port")
+
+
+# ---------------------------------------------------------------- emitter
+
+
+def test_emitter_drops_never_blocks():
+    """The zero-overhead pin: with a dead coordinator, emit() stays a
+    queue put — records drop (counted), the caller never waits."""
+    # a port with no listener: loopback connects fail fast
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    em = live_lib.TelemetryEmitter(
+        f"tcp://127.0.0.1:{port}", queue_slots=8,
+        connect_timeout_s=0.2, send_timeout_s=0.2, retry_s=0.05)
+    t0 = time.monotonic()
+    for i in range(500):
+        em.emit({"kind": "step", "step": i})
+    hot = time.monotonic() - t0
+    assert hot < 1.0, f"emit() blocked: 500 calls took {hot:.2f}s"
+    em.close(drain_s=0.2)
+    assert em.sent == 0
+    assert em.dropped > 0            # overflow and/or failed sends
+    assert em.stats()["endpoint"].endswith(str(port))
+    # a closed emitter swallows further emits
+    em.emit({"kind": "step", "step": -1})
+
+
+def test_emitter_to_aggregator_tcp(tmp_path):
+    agg, _ = make_agg(tmp_path, clk=None, clock=time.monotonic,
+                      wall=time.time)
+    port = agg.serve_ingest()
+    em = live_lib.TelemetryEmitter(f"127.0.0.1:{port}")
+    for i in range(5):
+        em.emit({"kind": "step", "step": i, "loss": 0.5})
+    deadline = time.monotonic() + 10
+    while agg.records < 5 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert agg.records >= 5
+    assert agg.snapshot()["pod"]["step"] == 4
+    assert em.sent == 5 and em.dropped == 0
+    em.close()
+    agg.close()
+
+
+def test_emitter_to_aggregator_udp(tmp_path):
+    agg, _ = make_agg(tmp_path, clk=None, clock=time.monotonic,
+                      wall=time.time)
+    port = agg.serve_ingest()
+    em = live_lib.TelemetryEmitter(f"udp://127.0.0.1:{port}")
+    for i in range(5):
+        em.emit({"kind": "heartbeat", "process_index": 0, "step": i})
+    deadline = time.monotonic() + 10
+    while agg.records < 5 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert agg.records >= 5
+    assert agg.snapshot()["hosts"]["0"]["step"] == 4
+    em.close()
+    agg.close()
+
+
+# --------------------------------------------------------- rolling window
+
+
+def test_rolling_window_rate_and_eviction():
+    w = live_lib.RollingWindow(window_s=10.0)
+    assert w.rate() is None
+    for t in range(0, 6):
+        w.add(100.0 + t, 2.0 * t)    # 2 steps/s
+    assert w.rate() == pytest.approx(2.0)
+    assert w.last() == 10.0
+    # points older than the window evict; the slope follows the tail
+    w.add(200.0, 10.0)
+    w.add(201.0, 15.0)
+    assert w.rate() == pytest.approx(5.0)
+
+
+# ----------------------------------------------- aggregator (scripted)
+
+
+def test_aggregator_multi_worker_windows_scripted(tmp_path):
+    agg, clk = make_agg(tmp_path, window_s=10.0)
+    for t in range(6):
+        clk.t = 1000.0 + t
+        agg.ingest({"kind": "heartbeat", "process_index": 0,
+                    "step": 2 * t, "epoch": 0, "phase": "train"},
+                   now=clk.t)
+        agg.ingest({"kind": "heartbeat", "process_index": 1, "step": t},
+                   now=clk.t)
+    snap = agg.snapshot()
+    assert snap["hosts"]["0"]["steps_per_sec"] == pytest.approx(2.0)
+    assert snap["hosts"]["1"]["steps_per_sec"] == pytest.approx(1.0)
+    assert snap["hosts"]["0"]["phase"] == "train"
+    assert snap["status"] == "ok"
+    agg.close()
+
+
+def test_aggregator_tick_fires_and_resolves_stall(tmp_path):
+    agg, clk = make_agg(tmp_path, stall_timeout_s=5.0, window_s=30.0)
+    for t in range(6):
+        clk.t = 1000.0 + t
+        agg.ingest({"kind": "heartbeat", "process_index": 0, "step": t},
+                   now=clk.t)
+        agg.ingest({"kind": "heartbeat", "process_index": 1, "step": t},
+                   now=clk.t)
+    # host 1 wedges; host 0 keeps stepping
+    for t in range(6, 12):
+        clk.t = 1000.0 + t
+        agg.ingest({"kind": "heartbeat", "process_index": 0, "step": t},
+                   now=clk.t)
+    agg.tick(now=clk.t)
+    firing = agg.engine.firing()
+    assert [a["host"] for a in firing if a["alert"] == "stall"] == [1]
+    assert agg.snapshot()["status"] == "alert"
+    # progress resumes -> the alert resolves on the next tick
+    clk.t = 1012.0
+    agg.ingest({"kind": "heartbeat", "process_index": 1, "step": 7},
+               now=clk.t)
+    agg.tick(now=clk.t)
+    # the stall cleared (a straggler alert may now legitimately fire
+    # instead: the resumed host's window rate lags the healthy one)
+    assert [a for a in agg.engine.firing() if a["alert"] == "stall"] \
+        == []
+    stall_hist = [a for a in agg.engine.snapshot()["history"]
+                  if a["alert"] == "stall"]
+    assert stall_hist[-1]["state"] == alerts_lib.RESOLVED
+    agg.close()
+
+
+def test_aggregator_stall_dump_fires_immediately(tmp_path):
+    """The watchdog's last-gasp record carries its own measured stall:
+    the alert fires on ingest, no age accounting needed."""
+    agg, clk = make_agg(tmp_path, stall_timeout_s=5.0)
+    agg.ingest({"kind": "stall_dump", "process_index": 2,
+                "stall_s": 9.0, "step": 17}, now=clk.t)
+    (a,) = agg.engine.firing()
+    assert a["alert"] == "stall" and a["host"] == 2
+    assert a["value"] == 9.0 and a["threshold"] == 5.0
+    agg.close()
+
+
+def test_aggregator_disabled_stall_window_never_fires(tmp_path):
+    agg, clk = make_agg(tmp_path, stall_timeout_s=0.0)
+    agg.ingest({"kind": "heartbeat", "process_index": 0, "step": 1},
+               now=clk.t)
+    clk.t += 1e6
+    agg.tick(now=clk.t)
+    agg.ingest({"kind": "stall_dump", "process_index": 0,
+                "stall_s": 1e6}, now=clk.t)
+    assert agg.engine.firing() == []
+    agg.close()
+
+
+def test_aggregator_status_file_and_alerts_jsonl(tmp_path):
+    agg, clk = make_agg(tmp_path)
+    agg.ingest({"kind": "step", "step": 4, "loss": 0.5,
+                "epoch": 0}, now=clk.t)
+    # alert transitions force an immediate status rewrite + a line in
+    # the append-only transition log
+    agg.ingest({"kind": "hosts", "straggler_ratio": 9.0}, now=clk.t)
+    with open(tmp_path / "live_status.json") as f:
+        doc = json.load(f)
+    assert doc["status"] == "alert"
+    assert doc["pod"]["step"] == 4 and doc["pod"]["loss"] == 0.5
+    assert doc["alerts"]["firing"][0]["alert"] == "straggler"
+    with open(tmp_path / "alerts.jsonl") as f:
+        lines = [json.loads(line) for line in f]
+    assert lines[0]["alert"] == "straggler"
+    assert lines[0]["state"] == alerts_lib.FIRING
+    agg.close()
+
+
+def test_aggregator_adopts_run_id_from_stream(tmp_path):
+    agg, clk = make_agg(tmp_path)
+    assert agg.snapshot()["run_id"] is None
+    agg.ingest({"kind": "step", "step": 1, "run_id": "abc123"},
+               now=clk.t)
+    assert agg.snapshot()["run_id"] == "abc123"
+    agg.close()
+
+
+def test_aggregator_regress_baseline(tmp_path):
+    agg, clk = make_agg(tmp_path, regress_baseline_sps=10.0)
+    agg.ingest({"kind": "epoch", "epoch": 0, "steps_per_sec": 5.0},
+               now=clk.t)
+    (a,) = agg.engine.firing()
+    assert a["alert"] == "regress"
+    assert a["value"] == pytest.approx(0.5)
+    # recovery above the floor resolves it
+    agg.ingest({"kind": "epoch", "epoch": 1, "steps_per_sec": 9.0},
+               now=clk.t)
+    assert agg.engine.firing() == []
+    agg.close()
+
+
+def test_aggregator_heartbeat_staging_overlap(tmp_path):
+    """The beacon's cheap counters yield the SAME observable the exit
+    verdict grades: overlap = 1 - wait/run."""
+    agg, clk = make_agg(tmp_path)
+    agg.ingest({"kind": "heartbeat", "process_index": 0, "step": 5,
+                "staging_streamed": True, "run_s": 10.0,
+                "staging_wait_s": 8.0}, now=clk.t)
+    (a,) = agg.engine.firing()
+    assert a["alert"] == "staging" and a["host"] == 0
+    assert a["value"] == pytest.approx(0.2)
+    snap = agg.snapshot()
+    assert snap["hosts"]["0"]["staging_overlap_fraction"] == \
+        pytest.approx(0.2)
+    # and the at-exit grader fails on the same number — parity
+    assert verdict_lib.staging_status(True, 0.2) == verdict_lib.FAIL
+    agg.close()
+
+
+def test_online_alerts_match_every_at_exit_fail(tmp_path):
+    """THE acceptance pin: a scripted run whose at-exit verdicts would
+    grade straggler/staging/comm/regress fail — and whose watchdog
+    dumped a stall — must have fired the matching live alert mid-run in
+    EVERY case, from the same numbers."""
+    agg, clk = make_agg(tmp_path, stall_timeout_s=5.0,
+                        regress_baseline_sps=10.0)
+    ratio, overlap, exposed, sps, stall = 2.0, 0.2, 0.5, 5.0, 9.0
+    agg.ingest({"kind": "hosts", "straggler_ratio": ratio}, now=clk.t)
+    agg.ingest({"kind": "timing", "staging_overlap_fraction": overlap},
+               now=clk.t)
+    agg.ingest({"kind": "devtime", "exposed_comm_frac": exposed},
+               now=clk.t)
+    agg.ingest({"kind": "epoch", "steps_per_sec": sps}, now=clk.t)
+    agg.ingest({"kind": "stall_dump", "process_index": 0,
+                "stall_s": stall}, now=clk.t)
+    fired = {a["alert"] for a in agg.engine.firing()}
+    assert fired == {t.name for t in rules_lib.ALERT_RULES}, fired
+
+    # the at-exit graders agree on every number (two step means with
+    # ratio 2.0 stand in for the hosts record's inputs)
+    assert verdict_lib.straggler_status([0.1, 0.2]) == verdict_lib.FAIL
+    assert verdict_lib.staging_status(True, overlap) == verdict_lib.FAIL
+    assert devtime_lib.comm_status(exposed) == verdict_lib.FAIL
+    assert report_lib.regression_section(
+        {"steps": 10, "run_s": 10 / sps},
+        {"steps_per_sec": 10.0},
+        rules_lib.resolve("regress"))["status"] == report_lib.FAIL
+    assert stall > 5.0               # the watchdog's own dump condition
+    agg.close()
+
+
+# ----------------------------------------------------- prometheus export
+
+
+SCRIPTED_STATUS = {
+    "schema": 1, "run_id": "r1", "requeue_attempt": 0,
+    "status": "alert",
+    "pod": {"step": 8, "steps_per_sec": 2.5},
+    "hosts": {"0": {"step": 8, "steps_per_sec": 2.5, "age_s": 0.5,
+                    "hbm_peak_bytes": None}},
+    "alerts": {"firing": [{"alert": "stall", "host": 0}], "events": 1},
+    "counters": {"records": 3, "bad_frames": 0},
+}
+
+GOLDEN_PROM = """\
+# HELP tpudist_up Live aggregator is running.
+# TYPE tpudist_up gauge
+tpudist_up 1
+# HELP tpudist_info Run identity (labels carry run_id and attempt).
+# TYPE tpudist_info gauge
+tpudist_info{run_id="r1",requeue_attempt="0"} 1
+# HELP tpudist_step Last global step seen on the metrics stream.
+# TYPE tpudist_step gauge
+tpudist_step 8
+# HELP tpudist_steps_per_sec Pod steps/s (last measured).
+# TYPE tpudist_steps_per_sec gauge
+tpudist_steps_per_sec 2.5
+# HELP tpudist_host_step Per-host last step from its heartbeat.
+# TYPE tpudist_host_step gauge
+tpudist_host_step{host="0"} 8
+# HELP tpudist_host_steps_per_sec Per-host rolling step rate.
+# TYPE tpudist_host_steps_per_sec gauge
+tpudist_host_steps_per_sec{host="0"} 2.5
+# HELP tpudist_host_progress_age_seconds Seconds since the host's step \
+last advanced.
+# TYPE tpudist_host_progress_age_seconds gauge
+tpudist_host_progress_age_seconds{host="0"} 0.5
+# HELP tpudist_alert_firing 1 while the named alert rule fires.
+# TYPE tpudist_alert_firing gauge
+tpudist_alert_firing{alert="straggler"} 0
+tpudist_alert_firing{alert="staging"} 0
+tpudist_alert_firing{alert="comm"} 0
+tpudist_alert_firing{alert="regress"} 0
+tpudist_alert_firing{alert="stall"} 1
+# HELP tpudist_alerts_total Alert fire/resolve transitions so far.
+# TYPE tpudist_alerts_total counter
+tpudist_alerts_total 1
+# HELP tpudist_records_total Telemetry records ingested.
+# TYPE tpudist_records_total counter
+tpudist_records_total 3
+# HELP tpudist_bad_frames_total Undecodable frames dropped.
+# TYPE tpudist_bad_frames_total counter
+tpudist_bad_frames_total 0
+"""
+
+
+def test_prometheus_text_golden():
+    """Exposition-format golden: exact output for a scripted status —
+    HELP/TYPE headers, label quoting, int-vs-float rendering, the
+    fixed-label alert_firing series, None-valued series omitted."""
+    assert live_lib.prometheus_text(SCRIPTED_STATUS) == GOLDEN_PROM
+
+
+def test_prometheus_escaping_and_numbers():
+    text = live_lib.prometheus_text(
+        {"run_id": 'we"ird\nid', "requeue_attempt": 2,
+         "pod": {"loss": 0.123456789012345},
+         "hosts": {}, "alerts": {}, "counters": {}})
+    assert r'run_id="we\"ird\nid"' in text
+    assert "tpudist_loss 0.123456789" in text
+
+
+def test_prometheus_alert_series_fixed_label_set():
+    """Scrapers alert on tpudist_alert_firing{alert=...} without knowing
+    hosts: one series per RULE, present (0 or 1) whether firing or
+    not."""
+    text = live_lib.prometheus_text(
+        {"pod": {}, "hosts": {}, "alerts": {"firing": []},
+         "counters": {}})
+    for t in rules_lib.ALERT_RULES:
+        assert f'tpudist_alert_firing{{alert="{t.name}"}} 0' in text
+
+
+# ----------------------------------------------------------- http server
+
+
+def test_http_exporter_endpoints(tmp_path):
+    agg, clk = make_agg(tmp_path)
+    agg.ingest({"kind": "step", "step": 3, "run_id": "web1"}, now=clk.t)
+    srv = live_lib.LiveHttpServer(agg, port=0)
+    base = f"http://127.0.0.1:{srv.port}"
+    try:
+        with urllib.request.urlopen(f"{base}/metrics", timeout=5) as r:
+            body = r.read().decode()
+            assert r.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+        assert "tpudist_up 1" in body and "tpudist_step 3" in body
+        with urllib.request.urlopen(f"{base}/status.json",
+                                    timeout=5) as r:
+            doc = json.loads(r.read())
+        assert doc["run_id"] == "web1" and doc["pod"]["step"] == 3
+        with urllib.request.urlopen(f"{base}/healthz", timeout=5) as r:
+            assert json.loads(r.read()) == {"ok": True}
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(f"{base}/nope", timeout=5)
+    finally:
+        srv.close()
+        agg.close()
+
+
+# --------------------------------------------------------------- tail CLI
+
+
+def scripted_status_doc():
+    return {
+        "schema": 1, "run_id": "tail1", "requeue_attempt": 0,
+        "ts": 1700000000.0, "status": "alert",
+        "pod": {"step": 12, "epoch": 1, "loss": 0.1234,
+                "steps_per_sec": 3.5, "staging_overlap_fraction": 0.9,
+                "exposed_comm_frac": 0.05},
+        "hosts": {"0": {"step": 12, "epoch": 1, "phase": "train",
+                        "steps_per_sec": 3.5, "age_s": 0.2,
+                        "hbm_peak_bytes": 1 << 20,
+                        "staging_overlap_fraction": 0.9}},
+        "alerts": {"firing": [
+            {"alert": "straggler", "host": None, "value": 1.8,
+             "threshold": 1.25, "duration_s": 4.2, "first_step": 9}],
+            "history": [
+                {"alert": "stall", "host": 0,
+                 "state": alerts_lib.RESOLVED, "first_step": 5,
+                 "duration_s": 2.0}],
+            "events": 3},
+        "counters": {"records": 20, "bad_frames": 0},
+    }
+
+
+def test_render_status_contents():
+    text = live_lib.render_status(scripted_status_doc())
+    assert "run tail1" in text and "ALERT" in text
+    assert "step 12" in text and "3.50 steps/s" in text
+    assert "train" in text                      # the active phase
+    assert "[straggler] value 1.8" in text
+    assert "threshold 1.25" in text
+    assert "[resolved] stall host0" in text
+
+
+def test_tail_cli_once_from_file(tmp_path, capsys):
+    path = tmp_path / "live_status.json"
+    path.write_text(json.dumps(scripted_status_doc()))
+    rc = live_lib.main(["tail", "--status", str(path), "--once"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "run tail1" in out and "ALERTS FIRING" in out
+
+
+def test_tail_cli_once_missing_file(tmp_path, capsys):
+    rc = live_lib.main(["tail", "--status",
+                        str(tmp_path / "nope.json"), "--once"])
+    assert rc == 2
+    assert "no status" in capsys.readouterr().err
+
+
+def test_tail_cli_once_from_url(tmp_path, capsys):
+    agg, clk = make_agg(tmp_path)
+    agg.ingest({"kind": "step", "step": 2, "run_id": "url1"}, now=clk.t)
+    srv = live_lib.LiveHttpServer(agg, port=0)
+    try:
+        rc = live_lib.main([
+            "tail", "--url",
+            f"http://127.0.0.1:{srv.port}/status.json", "--once"])
+        assert rc == 0
+        assert "run url1" in capsys.readouterr().out
+    finally:
+        srv.close()
+        agg.close()
+
+
+# ------------------------------------------------------- config resolve
+
+
+def test_resolve_live_defaults_off(monkeypatch):
+    monkeypatch.delenv("TPUDIST_LIVE", raising=False)
+    cfg = config_lib.parse_args([])
+    assert config_lib.resolve_live(cfg) == (False, 0, None)
+
+
+def test_resolve_live_flag_and_env(monkeypatch):
+    cfg = config_lib.parse_args(["--live", "on", "--live-port", "9109",
+                                 "--live-endpoint", "tcp://c:7000"])
+    assert config_lib.resolve_live(cfg) == (True, 9109, "tcp://c:7000")
+    monkeypatch.setenv("TPUDIST_LIVE", "on")
+    monkeypatch.setenv("TPUDIST_LIVE_PORT", "9110")
+    monkeypatch.setenv("TPUDIST_LIVE_ENDPOINT", "udp://c:7001")
+    cfg = config_lib.parse_args([])
+    assert config_lib.resolve_live(cfg) == (True, 9110, "udp://c:7001")
+    # the flag beats the env; falsy env spellings read as off
+    cfg = config_lib.parse_args(["--live", "off"])
+    assert config_lib.resolve_live(cfg)[0] is False
+    monkeypatch.setenv("TPUDIST_LIVE", "false")
+    cfg = config_lib.parse_args([])
+    assert config_lib.resolve_live(cfg)[0] is False
+
+
+# ------------------------------------------------ flight-recorder wiring
+
+
+def test_flightrec_beacons_and_stall_dump_ride_the_emitter(tmp_path):
+    fe = FakeEmitter()
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                         process_index=3, emitter=fe,
+                         beacon_extra=lambda: {"run_s": 1.5})
+    rec.note_progress(phase="train", epoch=0, step=7, run_id="r9")
+    rec.dump(reason="stall", stall_s=12.0)
+    rec.close()
+    sd = [r for r in fe.recs if r["kind"] == "stall_dump"]
+    assert sd and sd[0]["stall_s"] == 12.0
+    assert sd[0]["process_index"] == 3 and sd[0]["step"] == 7
+    assert sd[0]["run_id"] == "r9"   # correlation keys ride progress
+    hb = [r for r in fe.recs if r["kind"] == "heartbeat"]
+    assert hb and hb[-1]["run_s"] == 1.5 and hb[-1]["step"] == 7
+
+
+def test_flightrec_beacon_extra_failure_swallowed(tmp_path):
+    def boom():
+        raise RuntimeError("no extras today")
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                         process_index=0, beacon_extra=boom)
+    rec.note_progress(phase="train", step=1)
+    rec.close()                      # final beacon writes despite boom
+    with open(rec.beacon_path) as f:
+        assert json.load(f)["step"] == 1
+
+
+# -------------------------------------------------- train CLI integration
+
+
+def _run_train(capsys, argv):
+    rc = train_mod.main(argv)
+    return rc, capsys.readouterr().out
+
+
+def _metrics(save):
+    with open(os.path.join(save, "metrics.jsonl")) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def test_train_live_end_to_end(tmp_path, capsys, monkeypatch):
+    """--live on: the run loops back over a real socket, the aggregator
+    ends ok, every artifact carries the same run_id."""
+    monkeypatch.setenv("TPUDIST_RUN_ID", "e2e-live-1")
+    save = str(tmp_path / "ck")
+    rc, out = _run_train(capsys, [
+        "--epochs", "2", "--train-batch-size", "64", "--n-samples",
+        "320", "--log-every", "4", "--live", "on", "--save-dir", save])
+    assert rc == 0
+    assert "tpudist: live on: ingest" in out
+    assert "tpudist: live ok:" in out
+    with open(os.path.join(save, "live_status.json")) as f:
+        doc = json.load(f)
+    assert doc["status"] == "ok" and doc["run_id"] == "e2e-live-1"
+    assert doc["pod"]["step"] is not None
+    assert doc["hosts"]["0"]["step"] is not None
+    assert doc["counters"]["records"] > 0
+    # run_id stamping: every metrics record, the trace metadata, the
+    # heartbeat beacon, and the checkpoint meta all name the run
+    recs = _metrics(save)
+    assert recs and all(r.get("run_id") == "e2e-live-1" for r in recs)
+    assert all(r.get("requeue_attempt") == 0 for r in recs)
+    with open(os.path.join(save, "pod_trace.json")) as f:
+        assert json.load(f)["metadata"]["run_id"] == "e2e-live-1"
+    with open(os.path.join(save, "heartbeat.worker0")) as f:
+        assert json.load(f)["run_id"] == "e2e-live-1"
+    found_in_ckpt = False
+    for root, _, files in os.walk(save):
+        for fn in files:
+            try:
+                with open(os.path.join(root, fn), "rb") as f:
+                    if b"e2e-live-1" in f.read():
+                        found_in_ckpt = found_in_ckpt or "metrics" not in fn
+            except OSError:
+                pass
+    assert found_in_ckpt, "run_id not stamped into checkpoint meta"
+
+
+def test_train_live_on_off_bitwise_loss_parity(tmp_path, capsys):
+    """The overhead pin: telemetry must not touch device math — the
+    step-loss stream is BITWISE identical live-on vs --live off."""
+    outs = {}
+    for name, flags in (("off", []), ("on", ["--live", "on"])):
+        save = str(tmp_path / name)
+        rc, out = _run_train(capsys, [
+            "--epochs", "2", "--train-batch-size", "64", "--n-samples",
+            "320", "--log-every", "4", "--save-dir", save] + flags)
+        assert rc == 0
+        outs[name] = ([(r["step"], r["loss"]) for r in _metrics(save)
+                       if r["kind"] == "step"],
+                      [r["avg_loss"] for r in _metrics(save)
+                       if r["kind"] == "epoch"])
+    assert outs["on"][0] == outs["off"][0]    # bitwise: exact float repr
+    assert outs["on"][1] == outs["off"][1]
+    # and the disabled run produced NO live artifacts
+    assert not os.path.exists(tmp_path / "off" / "live_status.json")
+
+
+def test_train_live_off_constructs_nothing(tmp_path, capsys,
+                                           monkeypatch):
+    """--live off is the ABSENCE of the subsystem: no emitter, no
+    aggregator, no sockets — pinned by making every constructor
+    explode."""
+    def boom(*a, **k):
+        raise AssertionError("live telemetry constructed with live off")
+    monkeypatch.setattr(live_lib.LiveRun, "start", boom)
+    monkeypatch.setattr(live_lib, "TelemetryEmitter", boom)
+    monkeypatch.setattr(live_lib, "LiveAggregator", boom)
+    monkeypatch.setattr(live_lib, "LiveHttpServer", boom)
+    rc, out = _run_train(capsys, [
+        "--epochs", "1", "--train-batch-size", "64", "--n-samples",
+        "128", "--save-dir", str(tmp_path / "ck")])
+    assert rc == 0
+    assert "tpudist: live" not in out
+
+
+def test_resolve_run_id_env_and_generated(monkeypatch):
+    monkeypatch.setenv("TPUDIST_RUN_ID", "  fixed-id  ")
+    assert live_lib.resolve_run_id() == "fixed-id"
+    monkeypatch.delenv("TPUDIST_RUN_ID")
+    rid = live_lib.resolve_run_id()
+    assert len(rid) == 12 and rid != live_lib.resolve_run_id()
+
+
+# ------------------------------------------------- report Alerts section
+
+
+def _timing(**kv):
+    return {"kind": "timing", **kv}
+
+
+def test_alerts_section_cross_check_flags_misses():
+    timing = _timing(staging_status="fail", straggler_status="fail",
+                     comm_status="success")
+    history = [{"kind": "alert", "alert": "staging", "state": "firing",
+                "host": None, "first_step": 4, "first_ts": 10.0,
+                "duration_s": 2.5, "value": 0.2, "threshold": 0.5}]
+    sec = report_lib.alerts_section([timing], history, timing)
+    assert sec["enabled"] and sec["events"] == 1
+    assert sec["fired_rules"] == ["staging"]
+    (row,) = sec["history"]
+    assert row["first_step"] == 4 and row["duration_s"] == 2.5
+    # straggler failed at exit with no mid-run alert -> coverage gap
+    assert len(sec["warnings"]) == 1
+    assert "straggler" in sec["warnings"][0]
+
+
+def test_alerts_section_stall_dump_requires_stall_alert():
+    metrics = [{"kind": "stall_dump", "stall_s": 99.0}]
+    sec = report_lib.alerts_section(metrics, [], None)
+    assert any("stall" in w for w in sec["warnings"])
+    sec = report_lib.alerts_section(
+        metrics, [{"kind": "alert", "alert": "stall", "state": "firing",
+                   "host": 0, "first_ts": 1.0}], None)
+    assert sec["warnings"] == []
+
+
+def test_alerts_section_disabled_without_live_data():
+    sec = report_lib.alerts_section(
+        [_timing(staging_status="fail")], None,
+        _timing(staging_status="fail"))
+    assert not sec["enabled"]
+    assert sec["warnings"] == []     # nothing watched, nothing missed
+
+
+def test_alerts_section_falls_back_to_metrics_alert_records():
+    metrics = [{"kind": "alert", "alert": "comm", "state": "resolved",
+                "host": None, "first_step": 2, "first_ts": 5.0,
+                "duration_s": 1.0}]
+    sec = report_lib.alerts_section(metrics, None, None)
+    assert sec["enabled"] and sec["fired_rules"] == ["comm"]
+
+
+def test_report_cli_alerts_and_run_id(tmp_path, capsys):
+    run_dir = tmp_path / "run"
+    run_dir.mkdir()
+    recs = [
+        {"ts": 1.0, "mono": 0.1, "run_id": "rep1", "requeue_attempt": 0,
+         "kind": "epoch", "epoch": 0, "avg_loss": 0.5},
+        {"ts": 2.0, "mono": 0.2, "run_id": "rep1", "requeue_attempt": 0,
+         "kind": "timing", "steps": 8, "run_s": 1.0,
+         "staging_status": "fail", "straggler_status": "success"},
+    ]
+    (run_dir / "metrics.jsonl").write_text(
+        "\n".join(json.dumps(r) for r in recs) + "\n")
+    (run_dir / "trace.worker0.json").write_text(json.dumps(
+        {"traceEvents": [
+            {"name": "dispatch", "cat": "dispatch", "ph": "X",
+             "ts": 0.0, "dur": 100.0, "pid": 0, "tid": 0}],
+         "metadata": {"hosts": 1}}))
+    (run_dir / "alerts.jsonl").write_text(json.dumps(
+        {"kind": "alert", "alert": "staging", "state": "firing",
+         "host": None, "first_step": 4, "first_ts": 1.5,
+         "duration_s": 0.5, "value": 0.1, "threshold": 0.5}) + "\n")
+    rc = report_lib.main(["--run-dir", str(run_dir)])
+    assert rc == 0
+    rep = json.loads((run_dir / "run_report.json").read_text())
+    assert rep["run"]["run_id"] == "rep1"
+    assert rep["alerts"]["enabled"]
+    assert rep["alerts"]["fired_rules"] == ["staging"]
+    assert rep["alerts"]["warnings"] == []   # the fail HAD its alert
+    md = (run_dir / "run_report.md").read_text()
+    assert "## Alerts (live telemetry)" in md
+    assert "_run rep1_" in md
+    assert "| staging | pod | step 4 |" in md
+
+
+def test_report_regress_min_comes_from_rules(monkeypatch):
+    monkeypatch.setenv("TPUDIST_REGRESS_MIN", "0.99")
+    rep = report_lib.build_report(
+        [{"kind": "timing", "steps": 98, "run_s": 1.0}],
+        {"traceEvents": []},
+        baseline={"steps_per_sec": 100.0})
+    assert rep["regression"]["status"] == report_lib.FAIL
+    assert rep["regression"]["min_fraction"] == 0.99
+
+
+# ------------------------------------------------------ LiveRun facade
+
+
+def test_liverun_loopback_roundtrip(tmp_path):
+    live = live_lib.LiveRun.start(
+        is_coordinator=True, process_index=0, out_dir=str(tmp_path),
+        run_id="fac1", stall_timeout_s=0)
+    try:
+        assert live.aggregator is not None and live.emitter is not None
+        live.emit({"kind": "step", "step": 6, "loss": 0.25})
+        deadline = time.monotonic() + 10
+        while live.aggregator.snapshot()["pod"]["step"] != 6 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert live.aggregator.snapshot()["pod"]["step"] == 6
+        assert live.snapshot_fields()["run_id"] == "fac1"
+    finally:
+        live.close()
+    with open(tmp_path / "live_status.json") as f:
+        assert json.load(f)["pod"]["step"] == 6
+
+
+def test_liverun_worker_side_is_emitter_only(tmp_path):
+    agg, _ = make_agg(tmp_path, clk=None, clock=time.monotonic,
+                      wall=time.time)
+    port = agg.serve_ingest()
+    live = live_lib.LiveRun.start(
+        is_coordinator=False, process_index=1, out_dir=str(tmp_path),
+        endpoint=f"127.0.0.1:{port}")
+    try:
+        assert live.aggregator is None and live.exporter is None
+        assert live.snapshot_fields() is None
+        live.emit({"kind": "heartbeat", "process_index": 1, "step": 3})
+        deadline = time.monotonic() + 10
+        while agg.records < 1 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert agg.snapshot()["hosts"]["1"]["step"] == 3
+    finally:
+        live.close()
+        agg.close()
+
+
+# ------------------------------------------- review-fix regression pins
+
+
+def test_progress_counter_rearms_stall_without_step_change(tmp_path):
+    """A long eval/ckpt phase advances the note_progress counter but
+    not the step; the beacon's progress_n must re-arm the live stall
+    age exactly like it re-arms the watchdog — same-step heartbeats
+    with advancing progress_n are NOT a stall, frozen ones are."""
+    agg, clk = make_agg(tmp_path, stall_timeout_s=5.0)
+    for t in range(12):                      # eval: step parked at 30
+        clk.t = 1000.0 + t
+        agg.ingest({"kind": "heartbeat", "process_index": 0, "step": 30,
+                    "phase": "eval", "progress_n": 100 + t}, now=clk.t)
+    agg.tick(now=clk.t)
+    assert agg.engine.firing() == [], \
+        "advancing progress_n during eval must not read as a stall"
+    for t in range(12, 20):                  # now truly wedged
+        clk.t = 1000.0 + t
+        agg.ingest({"kind": "heartbeat", "process_index": 0, "step": 30,
+                    "phase": "eval", "progress_n": 111}, now=clk.t)
+    agg.tick(now=clk.t)
+    assert [a["alert"] for a in agg.engine.firing()] == ["stall"]
+    agg.close()
+
+
+def test_flightrec_beacon_carries_progress_counter(tmp_path):
+    """The beacon ships the watchdog's own any-progress counter so the
+    aggregator's stall accounting keys off the SAME signal."""
+    fe = FakeEmitter()
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                         process_index=0, emitter=fe)
+    rec.note_progress(phase="train", step=1)
+    rec.note_progress(phase="eval", step=1)   # phase flip, same step
+    rec.close()
+    hb = [r for r in fe.recs if r["kind"] == "heartbeat"]
+    assert hb and hb[-1]["progress_n"] == 2
+    with open(rec.beacon_path) as f:
+        assert json.load(f)["progress_n"] == 2
+
+
+def test_stall_dump_lands_in_metrics_stream(tmp_path):
+    """dump() writes kind=stall_dump into the metrics stream too (not
+    only the live bus), so the report's 'dump with no stall alert'
+    cross-check is reachable from real-run artifacts."""
+    from tpudist.metrics import MetricsLogger
+    m = MetricsLogger()
+    rec = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                         process_index=1, metrics=m)
+    rec.note_progress(phase="train", epoch=0, step=5)
+    rec.dump(reason="stall", stall_s=7.5)
+    rec.close()
+    sd = [r for r in m.history if r.get("kind") == "stall_dump"]
+    assert sd and sd[0]["stall_s"] == 7.5 and sd[0]["step"] == 5
+    # and the cross-check actually trips on exactly this shape when no
+    # stall alert fired mid-run
+    section = report_lib.alerts_section(m.history, [], None)
+    assert any("stall" in w for w in section["warnings"])
+
+
+def test_aggregator_concurrent_status_writes_never_tear(tmp_path):
+    """Ingest threads + forced alert writes race on live_status.json;
+    the write lock must keep every observed file a complete JSON doc."""
+    agg, clk = make_agg(tmp_path, status_min_interval_s=0.0)
+    stop = threading.Event()
+    bad = []
+
+    def reader():
+        while not stop.is_set():
+            try:
+                with open(tmp_path / "live_status.json") as f:
+                    json.load(f)
+            except FileNotFoundError:
+                pass
+            except Exception as e:      # torn write
+                bad.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for th in threads:
+        th.start()
+    def writer(pi):
+        for t in range(200):
+            agg.ingest({"kind": "heartbeat", "process_index": pi,
+                        "step": t}, now=1000.0 + t)
+    writers = [threading.Thread(target=writer, args=(pi,))
+               for pi in range(3)]
+    for th in writers:
+        th.start()
+    for th in writers:
+        th.join()
+    stop.set()
+    for th in threads:
+        th.join()
+    assert not bad, bad[:3]
+    agg.close()

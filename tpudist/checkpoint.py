@@ -87,12 +87,19 @@ class Checkpointer:
     """
 
     def __init__(self, save_dir: str, *, keep: Optional[int] = DEFAULT_KEEP,
-                 use_async: bool = True):
+                 use_async: bool = True,
+                 run_meta: Optional[dict] = None):
         self._mgr = _manager(save_dir, keep, use_async=use_async)
         self.last_enqueue_ms: float = 0.0
         self.last_drain_ms: float = 0.0
         self.drain_ms: float = 0.0   # cumulative blocked time at wait/close
         self.saves: int = 0
+        # stamped verbatim into every save's JSON meta (run_id /
+        # requeue_attempt — the correlation keys that tie a checkpoint
+        # to the metrics/trace artifacts of the attempt that wrote it);
+        # restore reads only its own epoch/step keys, so extras are
+        # forward-compatible by construction
+        self.run_meta = dict(run_meta or {})
 
     @property
     def last_save_ms(self) -> float:
@@ -118,7 +125,8 @@ class Checkpointer:
                 state=ocp.args.StandardSave(state),
                 meta=ocp.args.JsonSave({
                     "epoch": int(epoch),
-                    "step_in_epoch": int(step_in_epoch)})))
+                    "step_in_epoch": int(step_in_epoch),
+                    **self.run_meta})))
         self.last_enqueue_ms = (time.perf_counter() - t0) * 1000
         self.saves += 1
 

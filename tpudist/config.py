@@ -16,6 +16,8 @@ import os
 from dataclasses import dataclass
 from typing import Any, Optional, Sequence
 
+from tpudist import rules as rules_lib
+
 
 # context-parallel attention implementations (single source of truth;
 # tpudist.models.transformer imports this for its validation/errors)
@@ -151,6 +153,21 @@ class TrainConfig:
     # pod_trace.json on the coordinator (one Perfetto track per host)
     trace_dir: Optional[str] = None  # where trace artifacts land.
     # None = $TPUDIST_TRACE_DIR, else save_dir (next to metrics.jsonl)
+    live: Optional[str] = None    # on | off — live telemetry bus
+    # (obs.live): per-worker non-blocking emitters stream records +
+    # heartbeats to a coordinator aggregator that keeps rolling
+    # windows, runs the on-line alert engine over the SAME thresholds
+    # the exit verdict applies (tpudist.rules), rewrites
+    # live_status.json, and serves Prometheus /metrics.
+    # None = $TPUDIST_LIVE, else off (resolve_live)
+    live_port: int = 0            # Prometheus exporter port on the
+    # coordinator (/metrics, /status.json, /healthz). 0 =
+    # $TPUDIST_LIVE_PORT, else an ephemeral port
+    live_endpoint: Optional[str] = None  # ingest endpoint workers ship
+    # records to ([tcp://|udp://]host:port). None =
+    # $TPUDIST_LIVE_ENDPOINT, else the coordinator binds loopback on an
+    # ephemeral port (single-host runs); the launcher passes the
+    # coordinator's reachable address on pods
     data: DataConfig = dataclasses.field(default_factory=DataConfig)
     model: ModelConfig = dataclasses.field(default_factory=ModelConfig)
     parallel: ParallelConfig = dataclasses.field(default_factory=ParallelConfig)
@@ -420,8 +437,10 @@ def resolve_trace(cfg: TrainConfig) -> tuple[bool, str]:
 # Flight-recorder defaults: the stall window must comfortably exceed any
 # legitimate quiet period (a cold compile of the flagship superstep is
 # ~1-2 min on TPU) while still firing well inside the launcher's outer
-# TIMEOUT_S (default 1800) — the dump has to land BEFORE the kill.
-OBS_STALL_TIMEOUT_S = 300.0
+# TIMEOUT_S (default 1800) — the dump has to land BEFORE the kill. The
+# value itself lives in tpudist.rules: the live alert engine fires the
+# stall alert on the SAME window the watchdog dumps on.
+OBS_STALL_TIMEOUT_S = rules_lib.STALL_TIMEOUT_S
 OBS_HBM_SAMPLE_S = 2.0
 
 
@@ -466,6 +485,42 @@ def resolve_obs(cfg: TrainConfig) -> tuple[float, str, float]:
     if hbm_s < 0:
         raise ValueError(f"--hbm-sample-s must be >= 0, got {hbm_s}")
     return stall, out_dir, hbm_s
+
+
+# Live telemetry (tpudist.obs.live): OFF by default — unlike the span
+# tracer it opens sockets and threads, which a bare acceptance run
+# should not do unless an operator (or the launcher) asked for the view.
+LIVE_MODES = ("on", "off")
+
+
+def resolve_live(cfg: TrainConfig) -> tuple[bool, int, Optional[str]]:
+    """Resolve the live-telemetry knobs to ``(enabled, exporter_port,
+    ingest_endpoint)``.
+
+    Precedence per knob: explicit flag > env var > default (off, 0 =
+    ephemeral exporter port, no endpoint). ``TPUDIST_LIVE`` accepts the
+    usual truthy/falsy spellings so launchers can switch the bus
+    pod-wide without touching per-worker argv; ``TPUDIST_LIVE_ENDPOINT``
+    is how the launcher tells every worker where the coordinator's
+    aggregator listens (``[tcp://|udp://]host:port``) — without it a
+    single-host run loops back over an ephemeral loopback port, which
+    exercises the same socket path a pod does."""
+    mode = cfg.live
+    if mode is None:
+        raw = (os.environ.get("TPUDIST_LIVE") or "off").lower()
+        mode = "off" if raw in ("", "off", "0", "false", "no") else "on"
+    if mode not in LIVE_MODES:
+        raise ValueError(
+            f"--live must be one of {LIVE_MODES}, got {mode!r}")
+    port = cfg.live_port
+    if port < 0:
+        raise ValueError(f"--live-port must be >= 0, got {port}")
+    if port == 0:
+        env = _env_float("TPUDIST_LIVE_PORT")
+        port = int(env) if env and env > 0 else 0
+    endpoint = (cfg.live_endpoint
+                or os.environ.get("TPUDIST_LIVE_ENDPOINT") or None)
+    return mode == "on", port, endpoint
 
 
 def flagship_model_config(max_seq_len: int = 512) -> ModelConfig:
@@ -653,6 +708,26 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="directory for trace.worker<i>.json / "
                         "pod_trace.json (default: $TPUDIST_TRACE_DIR, "
                         "else --save-dir)")
+    p.add_argument("--live", type=str, default=None,
+                   choices=list(LIVE_MODES),
+                   help="live telemetry bus (obs.live): non-blocking "
+                        "per-worker emitters stream records + heartbeats "
+                        "to a coordinator aggregator that runs the "
+                        "on-line alert engine over the SAME thresholds "
+                        "as the exit verdict (tpudist.rules), rewrites "
+                        "live_status.json, and serves Prometheus "
+                        "/metrics (default: $TPUDIST_LIVE, else off)")
+    p.add_argument("--live-port", type=int, default=0,
+                   help="Prometheus exporter port on the coordinator "
+                        "(/metrics, /status.json, /healthz; default: "
+                        "$TPUDIST_LIVE_PORT, else an ephemeral port)")
+    p.add_argument("--live-endpoint", type=str, default=None,
+                   help="ingest endpoint workers ship records to "
+                        "([tcp://|udp://]host:port; default: "
+                        "$TPUDIST_LIVE_ENDPOINT, else the coordinator "
+                        "binds loopback on an ephemeral port — the "
+                        "launcher passes the coordinator's reachable "
+                        "address on pods)")
     args = p.parse_known_args(argv)[0]
 
     return TrainConfig(
@@ -690,6 +765,9 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         autotune_trials=args.autotune_trials,
         trace=args.trace,
         trace_dir=args.trace_dir,
+        live=args.live,
+        live_port=args.live_port,
+        live_endpoint=args.live_endpoint,
         data=DataConfig(n_samples=args.n_samples, n_features=args.n_features,
                         seed=args.seed),
         model=ModelConfig(name=args.model, n_features=args.n_features,

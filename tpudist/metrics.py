@@ -112,11 +112,22 @@ class MetricsLogger:
     (unhandled exception included), and the flight-recorder watchdog
     flushes from its stall dump; a lock makes that cross-thread flush
     safe against the main thread's concurrent ``log()``.
+
+    ``extra`` is stamped into EVERY record (under the record's own
+    keys — a record naming ``requeue_attempt`` itself wins): the run
+    correlation id + requeue attempt land on every line, so artifacts
+    from different attempts of one requeue loop stay correlatable.
+    ``emitter`` is the live-telemetry fan-out (obs.live
+    ``TelemetryEmitter``): when set, every record ALSO goes onto the
+    emitter's bounded non-blocking queue — one ``is not None`` check
+    when unset, so ``--live off`` costs nothing.
     """
     path: Optional[str] = None
     _fh: Optional[IO] = None
     history: List[Dict] = field(default_factory=list)
     _buf: List[str] = field(default_factory=list)
+    extra: Dict[str, Any] = field(default_factory=dict)
+    emitter: Any = None
 
     def __post_init__(self) -> None:
         import atexit
@@ -132,11 +143,16 @@ class MetricsLogger:
         # monotonic ``mono`` (same perf_counter timebase as the span
         # tracer's microsecond stamps) so the offline report CLI aligns
         # metrics with trace spans without trusting NTP
-        rec = dict(ts=time.time(), mono=time.perf_counter(), **kv)
+        rec = {"ts": time.time(), "mono": time.perf_counter(),
+               **self.extra, **kv}
         with self._lock:
             self.history.append(rec)
             if self.path:
                 self._buf.append(json.dumps(rec))
+        if self.emitter is not None:
+            # live fan-out, OUTSIDE the lock: emit() is a put_nowait
+            # that never blocks or raises (obs.live drop-not-block)
+            self.emitter.emit(rec)
 
     def flush(self) -> None:
         """Write buffered records out — called off the step path (epoch

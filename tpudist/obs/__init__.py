@@ -53,28 +53,62 @@ class PodObserver:
     def __init__(self, *, out_dir: str, stall_timeout_s: float = 300.0,
                  hbm_sample_s: float = 2.0, metrics: Any = None,
                  process_index: int = 0, process_count: int = 1,
-                 stall_hook: Any = None):
+                 stall_hook: Any = None, live: Any = None,
+                 live_fields: Any = None):
         self.hbm = (HbmSampler(period_s=hbm_sample_s)
                     if hbm_sample_s > 0 else None)
         self.hosts = HostStepStats(process_index=process_index,
                                    process_count=process_count)
+        self.live = live
+
+        def _extra_state() -> Dict[str, Any]:
+            # the flight-record extras: HBM watermarks, plus — on the
+            # coordinator of a live run — the aggregator's last
+            # rolling-window snapshot (lock-free wholesale-replaced
+            # dict, obs.live), so a pre-kill dump says what the POD
+            # looked like, not just this process
+            out = dict(self.hbm.split()) if self.hbm is not None else {}
+            if live is not None:
+                snap = live.snapshot_fields()
+                if snap is not None:
+                    out["live_status"] = snap
+            return out
+
+        def _beacon_extra() -> Dict[str, Any]:
+            # live slice of the heartbeat beacon: the SAME observables
+            # the exit verdict grades (staging overlap inputs, HBM
+            # peak), cheap counter reads only — no fences, no jax
+            out: Dict[str, Any] = {}
+            if self.hbm is not None:
+                out["hbm_peak_bytes"] = self.hbm.peak_in_use or None
+            if live_fields is not None:
+                try:
+                    out.update(live_fields())
+                except Exception:
+                    pass
+            return out
+
         self.recorder = FlightRecorder(
             out_dir, stall_timeout_s=stall_timeout_s,
             process_index=process_index, metrics=metrics,
-            extra_state=(self.hbm.split if self.hbm else None),
-            tracer=trace.get(), stall_hook=stall_hook)
+            extra_state=_extra_state,
+            tracer=trace.get(), stall_hook=stall_hook,
+            emitter=(live.emitter if live is not None else None),
+            beacon_extra=_beacon_extra)
         self._closed = False
 
     @classmethod
     def from_config(cls, cfg, *, metrics=None, process_index: int = 0,
                     process_count: int = 1,
-                    stall_hook: Any = None) -> "PodObserver":
+                    stall_hook: Any = None, live: Any = None,
+                    live_fields: Any = None) -> "PodObserver":
         from tpudist.config import resolve_obs
         stall_s, out_dir, hbm_s = resolve_obs(cfg)
         return cls(out_dir=out_dir, stall_timeout_s=stall_s,
                    hbm_sample_s=hbm_s, metrics=metrics,
                    process_index=process_index,
-                   process_count=process_count, stall_hook=stall_hook)
+                   process_count=process_count, stall_hook=stall_hook,
+                   live=live, live_fields=live_fields)
 
     def note_progress(self, **kv: Any) -> None:
         self.recorder.note_progress(**kv)

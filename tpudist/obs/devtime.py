@@ -49,6 +49,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 # tpudist.verdict is import-safe on the jax-free offline path (its jax
 # uses are lazy), so the status vocabulary has one home
+from tpudist import rules as rules_lib
 from tpudist.verdict import FAIL, SUCCESS, UNGATEABLE
 
 Interval = Tuple[float, float]
@@ -339,7 +340,9 @@ def analyze_capture(capture_dir: str) -> Dict[str, Any]:
 # spent on UN-hidden collectives, the run is flagged — the pod is
 # paying for its fabric in steps/s. Advisory, like the staging and
 # straggler gates; env override TPUDIST_COMM_EXPOSED_MAX (call time).
-COMM_EXPOSED_MAX = 0.25
+# The threshold itself lives in tpudist.rules, shared with the live
+# alert engine so mid-run and at-exit grading cannot drift.
+COMM_EXPOSED_MAX = rules_lib.COMM_EXPOSED_MAX
 
 
 def comm_status(exposed_frac: Optional[float],
@@ -349,11 +352,7 @@ def comm_status(exposed_frac: Optional[float],
     SUCCESS/FAIL by whether the exposed-comm fraction of the device
     window stays under the threshold."""
     if max_frac is None:
-        raw = os.environ.get("TPUDIST_COMM_EXPOSED_MAX")
-        try:
-            max_frac = float(raw) if raw else COMM_EXPOSED_MAX
-        except ValueError:
-            max_frac = COMM_EXPOSED_MAX
+        max_frac = rules_lib.resolve("comm")
     if exposed_frac is None:
         return UNGATEABLE
     return SUCCESS if exposed_frac <= max_frac else FAIL

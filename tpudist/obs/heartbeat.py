@@ -68,6 +68,19 @@ class FlightRecorder:
         this: a run that stalls with a capture window open still stops
         the profiler cleanly and keeps the partial device timeline
         next to the flight record.
+      * ``emitter`` — a live-telemetry emitter (obs.live
+        ``TelemetryEmitter``): every beacon ALSO ships as a
+        ``kind=heartbeat`` record to the coordinator's aggregator (the
+        per-host liveness signal the on-line stall alert keys off),
+        and the stall dump ships a ``kind=stall_dump`` record FIRST —
+        before the slow stack/memory collection below — so the firing
+        alert reaches the Prometheus exporter while the launcher's
+        outer timeout is still minutes away. ``emit`` is a lock-free
+        bounded put (same discipline as ``WindowProfiler.
+        emergency_stop``): a wedged run cannot wedge its own telemetry.
+      * ``beacon_extra`` — optional callable whose dict folds into
+        every beacon (live staging/HBM counters ride along; failures
+        are swallowed — the beacon is best-effort by contract).
     """
 
     def __init__(self, out_dir: str, *, stall_timeout_s: float = 300.0,
@@ -75,7 +88,9 @@ class FlightRecorder:
                  extra_state: Optional[Callable[[], Dict]] = None,
                  tracer: Any = None, last_n_metrics: int = 50,
                  last_n_spans: int = 64,
-                 stall_hook: Optional[Callable[[], Optional[str]]] = None):
+                 stall_hook: Optional[Callable[[], Optional[str]]] = None,
+                 emitter: Any = None,
+                 beacon_extra: Optional[Callable[[], Dict]] = None):
         if stall_timeout_s < 0:
             raise ValueError(
                 f"stall_timeout_s must be >= 0, got {stall_timeout_s}")
@@ -86,6 +101,8 @@ class FlightRecorder:
         self.extra_state = extra_state
         self.tracer = tracer
         self.stall_hook = stall_hook
+        self.emitter = emitter
+        self.beacon_extra = beacon_extra
         self.last_n_metrics = last_n_metrics
         self.last_n_spans = last_n_spans
         self.beacon_path = os.path.join(
@@ -142,17 +159,35 @@ class FlightRecorder:
                 dumped_this_stall = True
 
     def _write_beacon(self) -> None:
+        # progress_n is the note_progress call counter — the SAME
+        # signal this watchdog's own stall detection keys off (any
+        # progress re-arms it: phase flips during long eval/ckpt
+        # included, not just step advances), shipped so the live
+        # aggregator's stall-age accounting agrees with the watchdog
+        payload = {**self._progress, "beacon_ts": time.time(),
+                   "progress_n": self._count}
+        if self.beacon_extra is not None:
+            try:
+                payload.update(self.beacon_extra())
+            except Exception:
+                pass   # extras are a bonus; the beacon core still beats
         try:
             os.makedirs(self.out_dir, exist_ok=True)
             tmp = f"{self.beacon_path}.tmp"
             with open(tmp, "w") as f:
-                json.dump({**self._progress, "beacon_ts": time.time()}, f)
+                json.dump(payload, f)
             os.replace(tmp, self.beacon_path)
             self.beacons += 1
         except Exception:
             # the beacon is best-effort; a full disk must not kill the
             # watchdog (the flight record is the part that matters)
             pass
+        if self.emitter is not None:
+            # the live bus's per-host liveness signal: the aggregator's
+            # progress-age accounting (and so the on-line stall alert)
+            # keys off these, so they flow even when the FILE write
+            # above failed — a full disk must not blind the exporter
+            self.emitter.emit({"kind": "heartbeat", **payload})
 
     # ----------------------------------------------------------- dump
     def dump(self, reason: str = "manual",
@@ -160,8 +195,28 @@ class FlightRecorder:
         """Write the flight record now (the watchdog calls this on
         stall; the launcher-facing contract is the artifact's existence,
         so it is also callable directly for drills/tests)."""
+        if self.emitter is not None:
+            # FIRST, before the slow stack/memory collection below: the
+            # measured stall rides to the aggregator so the stall alert
+            # is firing — on disk in live_status.json and scrapeable at
+            # /metrics — before the launcher's kill, not after
+            self.emitter.emit({"kind": "stall_dump", "reason": reason,
+                               "stall_s": stall_s, **self._progress})
         history = []
         if self.metrics is not None:
+            # the stall dump also lands in the metrics stream itself —
+            # the offline report's Alerts cross-check ("a watchdog dump
+            # with no mid-run stall alert is a live-coverage gap") reads
+            # metrics.jsonl, so the evidence must exist there too, not
+            # only on the live bus
+            try:
+                self.metrics.log(kind="stall_dump", reason=reason,
+                                 stall_s=stall_s,
+                                 **{k: self._progress.get(k)
+                                    for k in ("phase", "step", "epoch",
+                                              "process_index")})
+            except Exception:
+                pass
             try:
                 history = list(self.metrics.history)[-self.last_n_metrics:]
             except Exception:

@@ -1,0 +1,1101 @@
+"""Live pod telemetry: streaming metrics bus, on-line status, Prometheus.
+
+Everything the framework produced before this module was post-mortem:
+the verdict grades at exit, the flight recorder dumps on a stall, the
+run report runs after ``pod_trace.json`` lands. An operator watching a
+multi-hour pod job had no view of it *while it runs*. This module is
+that view, in four pieces:
+
+  * :class:`TelemetryEmitter` — per-worker, NON-BLOCKING: records go
+    into a bounded queue (``put_nowait``; full queue = dropped record +
+    counter, never a blocked step loop — the PR 5 tracer's
+    zero-overhead discipline, pinned by the bitwise live-on/off parity
+    test) and a background thread ships them as length-prefixed JSON
+    frames over TCP (or UDP) to the coordinator. A wedged socket costs
+    the sender thread, not the train loop.
+  * :class:`LiveAggregator` — coordinator-side: ingests every worker's
+    stream (heartbeat beacons + the rank-0 metrics fan-out), keeps
+    rolling windows (pod steps/s, per-host rates and progress ages,
+    staging overlap, HBM watermarks, exposed-comm fraction, ckpt drain
+    stalls), drives the on-line :class:`~tpudist.obs.alerts.AlertEngine`
+    over the SAME thresholds the exit verdict applies
+    (:mod:`tpudist.rules`), and atomically rewrites
+    ``live_status.json`` + appends ``alerts.jsonl``.
+  * :class:`LiveHttpServer` — a stdlib ``http.server`` exposing the
+    aggregator as Prometheus text format (``/metrics``), JSON
+    (``/status.json``) and a liveness probe (``/healthz``). Handlers
+    read the aggregator's last snapshot — a wholesale-replaced dict,
+    so serving a scrape takes NO lock shared with ingest (the
+    ``note_progress`` discipline): a firing stall alert reaches the
+    exporter even while the run is wedged.
+  * ``python -m tpudist.obs.live tail`` — a terminal dashboard over
+    ``/status.json`` or the ``live_status.json`` file: per-host rates,
+    active phase, firing alerts.
+
+The exporter, tail CLI, frame codec and aggregator are jax-free (the
+offline-tooling contract shared with :mod:`tpudist.obs.report`); only
+:func:`resolve_run_id`'s multi-host broadcast imports jax, at call
+time. ``--live off`` (the default) constructs NONE of this — no
+sockets, no threads, no queue, zero added syscalls.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import struct
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpudist import rules as rules_lib
+from tpudist.obs import alerts as alerts_lib
+
+LIVE_SCHEMA_VERSION = 1
+STATUS_NAME = "live_status.json"
+ALERTS_NAME = "alerts.jsonl"
+
+# Emitter queue depth: at the train loop's record rate (a few records
+# per logging boundary plus one beacon every couple of seconds) this
+# holds minutes of backlog; past it the emitter DROPS — the step loop
+# never blocks on telemetry.
+DEFAULT_QUEUE_SLOTS = 1024
+# A frame longer than this is a corrupt length prefix, not a record —
+# the decoder resynchronises by dropping its buffer.
+MAX_FRAME_BYTES = 1 << 20
+
+
+# ------------------------------------------------------------ wire format
+
+_LEN = struct.Struct(">I")
+
+
+def encode_frame(rec: Dict[str, Any]) -> bytes:
+    """One record as a length-prefixed JSON frame (4-byte big-endian
+    length + UTF-8 payload). The same framing rides TCP streams and UDP
+    datagrams, so both transports share one codec."""
+    payload = json.dumps(rec, separators=(",", ":"),
+                         default=str).encode("utf-8")
+    return _LEN.pack(len(payload)) + payload
+
+
+class FrameDecoder:
+    """Incremental frame parser for one TCP connection (or one UDP
+    datagram). Tolerates partial reads; a corrupt length prefix or
+    unparseable payload bumps ``bad`` and resynchronises rather than
+    wedging the aggregator on one bad peer."""
+
+    def __init__(self) -> None:
+        self._buf = b""
+        self.bad = 0
+
+    def feed(self, data: bytes) -> List[Dict[str, Any]]:
+        self._buf += data
+        out: List[Dict[str, Any]] = []
+        while len(self._buf) >= _LEN.size:
+            (n,) = _LEN.unpack_from(self._buf)
+            if n > MAX_FRAME_BYTES:
+                self.bad += 1
+                self._buf = b""
+                break
+            if len(self._buf) < _LEN.size + n:
+                break
+            raw = self._buf[_LEN.size:_LEN.size + n]
+            self._buf = self._buf[_LEN.size + n:]
+            try:
+                rec = json.loads(raw)
+                if isinstance(rec, dict):
+                    out.append(rec)
+                else:
+                    self.bad += 1
+            except Exception:
+                self.bad += 1
+        return out
+
+
+def parse_endpoint(endpoint: str) -> Tuple[str, Tuple[str, int]]:
+    """``[tcp://|udp://]host:port`` → ``(transport, (host, port))``."""
+    transport = "tcp"
+    rest = endpoint
+    if "://" in endpoint:
+        scheme, rest = endpoint.split("://", 1)
+        if scheme not in ("tcp", "udp"):
+            raise ValueError(
+                f"live endpoint transport must be tcp or udp, got "
+                f"{scheme!r} in {endpoint!r}")
+        transport = scheme
+    host, sep, port_s = rest.rpartition(":")
+    if not sep or not port_s.isdigit():
+        raise ValueError(
+            f"live endpoint must be [tcp://|udp://]host:port, got "
+            f"{endpoint!r}")
+    return transport, (host or "127.0.0.1", int(port_s))
+
+
+# --------------------------------------------------------------- emitter
+
+
+class TelemetryEmitter:
+    """Per-worker non-blocking record shipper.
+
+    ``emit()`` is the ONLY entry point the train loop (and the beacon
+    thread) touches: a ``put_nowait`` onto a bounded queue — a full
+    queue drops the record and bumps ``dropped``, it never waits. The
+    sender thread owns every socket operation; connect/send timeouts
+    plus a reconnect backoff mean a dead or wedged coordinator costs
+    dropped records, never a blocked caller. Same posture as the span
+    tracer: telemetry must not be able to slow the thing it observes.
+    """
+
+    def __init__(self, endpoint: str, *,
+                 queue_slots: int = DEFAULT_QUEUE_SLOTS,
+                 connect_timeout_s: float = 2.0,
+                 send_timeout_s: float = 2.0,
+                 retry_s: float = 0.5):
+        import queue as queue_mod
+        self.transport, self.addr = parse_endpoint(endpoint)
+        self.endpoint = endpoint
+        self.connect_timeout_s = connect_timeout_s
+        self.send_timeout_s = send_timeout_s
+        self.retry_s = retry_s
+        self._q: Any = queue_mod.Queue(maxsize=max(1, queue_slots))
+        self._full = queue_mod.Full
+        self._empty = queue_mod.Empty
+        self.sent = 0
+        self.dropped = 0
+        self.errors = 0
+        self._sock: Optional[socket.socket] = None
+        self._next_connect = 0.0
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="tpudist-live-emit", daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------- hot path
+    def emit(self, rec: Dict[str, Any]) -> None:
+        """Enqueue one record; never blocks, never raises."""
+        if self._stop.is_set():
+            return
+        try:
+            self._q.put_nowait(rec)
+        except self._full:
+            self.dropped += 1
+
+    # --------------------------------------------------- sender thread
+    def _loop(self) -> None:
+        while True:
+            try:
+                rec = self._q.get(timeout=0.1)
+            except self._empty:
+                if self._stop.is_set():
+                    return
+                continue
+            self._send(rec)
+
+    def _send(self, rec: Dict[str, Any]) -> None:
+        try:
+            frame = encode_frame(rec)
+            if self.transport == "udp":
+                if self._sock is None:
+                    self._sock = socket.socket(socket.AF_INET,
+                                               socket.SOCK_DGRAM)
+                self._sock.sendto(frame, self.addr)
+            else:
+                if self._sock is None:
+                    if time.monotonic() < self._next_connect:
+                        raise ConnectionError("reconnect backoff")
+                    s = socket.create_connection(
+                        self.addr, timeout=self.connect_timeout_s)
+                    s.settimeout(self.send_timeout_s)
+                    self._sock = s
+                self._sock.sendall(frame)
+            self.sent += 1
+        except Exception:
+            # drop-not-block: the record is lost, counted, and the
+            # sender moves on; the NEXT connect attempt is rate-limited
+            self.errors += 1
+            self.dropped += 1
+            if self._sock is not None:
+                try:
+                    self._sock.close()
+                except Exception:
+                    pass
+                self._sock = None
+            self._next_connect = time.monotonic() + self.retry_s
+
+    def close(self, drain_s: float = 1.0) -> None:
+        """Bounded drain then stop — run exit must not hang on a dead
+        coordinator (whatever is still queued past the deadline is
+        counted as dropped by omission)."""
+        deadline = time.monotonic() + max(0.0, drain_s)
+        while (not self._q.empty() and self._thread.is_alive()
+               and time.monotonic() < deadline):
+            time.sleep(0.02)
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except Exception:
+                pass
+            self._sock = None
+
+    def stats(self) -> Dict[str, Any]:
+        return {"endpoint": self.endpoint, "sent": self.sent,
+                "dropped": self.dropped, "errors": self.errors,
+                "queued": self._q.qsize()}
+
+
+# ------------------------------------------------------- rolling windows
+
+
+class RollingWindow:
+    """Monotone counter samples within the last ``window_s`` seconds;
+    ``rate()`` is the counter's slope over the surviving span."""
+
+    def __init__(self, window_s: float = 30.0):
+        self.window_s = float(window_s)
+        self._pts: deque = deque()
+
+    def add(self, t: float, v: float) -> None:
+        self._pts.append((t, v))
+        cutoff = t - self.window_s
+        while len(self._pts) > 1 and self._pts[0][0] < cutoff:
+            self._pts.popleft()
+
+    def rate(self) -> Optional[float]:
+        if len(self._pts) < 2:
+            return None
+        (t0, v0), (t1, v1) = self._pts[0], self._pts[-1]
+        return (v1 - v0) / (t1 - t0) if t1 > t0 else None
+
+    def last(self) -> Optional[float]:
+        return self._pts[-1][1] if self._pts else None
+
+
+# ------------------------------------------------------------ aggregator
+
+
+class LiveAggregator:
+    """Coordinator-side rolling view of the pod + the on-line alerts.
+
+    ``ingest(rec)`` accepts any record from the bus — heartbeat beacons
+    from every worker, the rank-0 metrics fan-out (``kind=step/epoch/
+    hosts/timing/ckpt/devtime/resume``), and the watchdog's last-gasp
+    ``kind=stall_dump`` — updates the rolling windows, and feeds the
+    alert engine. ``tick()`` evaluates the time-based rules (stall ages,
+    live straggler ratios from beacon-derived rates). Both rebuild
+    ``self._status``, a plain dict REPLACED WHOLESALE so the exporter
+    and the flight recorder's stall dump read it without any lock
+    (:meth:`snapshot`), and write ``live_status.json`` atomically
+    (rate-limited; alert transitions force a write so a breach is on
+    disk and scrapeable before any launcher kill).
+
+    Scripted tests pass ``start_ticker=False`` plus explicit ``now=``
+    values, and a fake ``wall`` clock into the engine, making windows
+    and alert durations deterministic.
+    """
+
+    def __init__(self, *, out_dir: str, run_id: Optional[str] = None,
+                 requeue_attempt: int = 0,
+                 stall_timeout_s: Optional[float] = None,
+                 window_s: float = 30.0,
+                 regress_baseline_sps: Optional[float] = None,
+                 metrics: Any = None,
+                 status_min_interval_s: float = 0.25,
+                 clock: Callable[[], float] = time.monotonic,
+                 wall: Callable[[], float] = time.time,
+                 start_ticker: bool = True):
+        self.out_dir = out_dir
+        os.makedirs(out_dir, exist_ok=True)
+        self.status_path = os.path.join(out_dir, STATUS_NAME)
+        self.alerts_path = os.path.join(out_dir, ALERTS_NAME)
+        self.run_id = run_id
+        self.requeue_attempt = requeue_attempt
+        self.stall_timeout_s = (rules_lib.resolve("stall")
+                                if stall_timeout_s is None
+                                else float(stall_timeout_s))
+        self.window_s = window_s
+        if regress_baseline_sps is None:
+            raw = os.environ.get("TPUDIST_LIVE_BASELINE_SPS")
+            try:
+                regress_baseline_sps = float(raw) if raw else None
+            except ValueError:
+                regress_baseline_sps = None
+        self.regress_baseline_sps = regress_baseline_sps
+        self.metrics = metrics
+        self.clock = clock
+        self.wall = wall
+        self.engine = alerts_lib.AlertEngine(on_event=self._on_event,
+                                             clock=wall)
+        self._lock = threading.RLock()
+        self._hosts: Dict[int, Dict[str, Any]] = {}
+        self._pod: Dict[str, Any] = {
+            "step": None, "epoch": None, "loss": None,
+            "steps_per_sec": None, "straggler_ratio": None,
+            "staging_overlap_fraction": None, "exposed_comm_frac": None,
+            "ckpt_last_enqueue_ms": None, "ckpt_drain_ms": None,
+            "ckpt_saves": 0, "resume": None, "timing_seen": False}
+        self._pod_window = RollingWindow(window_s)
+        self.records = 0
+        self.bad_frames = 0
+        self._alerts_fh = None
+        self._last_write = 0.0
+        # serialises the throttle check + tmp-file write/rename: ingest
+        # threads, the ticker, and forced alert writes all land here,
+        # and two writers sharing one .tmp path would tear the file
+        self._write_lock = threading.Lock()
+        self.status_min_interval_s = status_min_interval_s
+        self._servers: List[Any] = []
+        self._conns: List[socket.socket] = []
+        self._threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+        self._status: Dict[str, Any] = {}
+        self._rebuild(force_write=False)
+        if start_ticker:
+            period = 0.5
+            if self.stall_timeout_s > 0:
+                period = min(1.0, max(0.05, self.stall_timeout_s / 4.0))
+            t = threading.Thread(target=self._tick_loop, args=(period,),
+                                 name="tpudist-live-agg", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    # ---------------------------------------------------------- ingest
+    def ingest(self, rec: Dict[str, Any],
+               now: Optional[float] = None) -> None:
+        now = self.clock() if now is None else now
+        with self._lock:
+            self._ingest_locked(rec, now)
+        self._rebuild()
+
+    def _ingest_locked(self, rec: Dict[str, Any], now: float) -> None:
+        self.records += 1
+        if self.run_id is None and rec.get("run_id"):
+            self.run_id = str(rec["run_id"])
+        kind = rec.get("kind")
+        if kind == "heartbeat":
+            self._ingest_heartbeat(rec, now)
+        elif kind == "step":
+            step = rec.get("step")
+            if isinstance(step, (int, float)):
+                self._pod["step"] = int(step)
+                self._pod_window.add(now, float(step))
+            for k in ("epoch", "loss"):
+                if rec.get(k) is not None:
+                    self._pod[k] = rec[k]
+            self._observe_rate(rec.get("steps_per_sec"))
+        elif kind == "epoch":
+            for k in ("epoch", "steps_per_sec"):
+                if rec.get(k) is not None:
+                    self._pod[k] = rec[k]
+            if rec.get("avg_loss") is not None:
+                self._pod["loss"] = rec["avg_loss"]
+            self._observe_rate(rec.get("steps_per_sec"))
+        elif kind == "hosts":
+            ratio = rec.get("straggler_ratio")
+            self._pod["straggler_ratio"] = ratio
+            self.engine.observe("straggler", ratio,
+                                step=self._pod.get("step"))
+        elif kind == "timing":
+            self._pod["timing_seen"] = True
+            ov = rec.get("staging_overlap_fraction")
+            if ov is not None:
+                self._pod["staging_overlap_fraction"] = ov
+                self.engine.observe("staging", ov,
+                                    step=self._pod.get("step"))
+        elif kind == "devtime":
+            frac = rec.get("exposed_comm_frac")
+            self._pod["exposed_comm_frac"] = frac
+            self.engine.observe("comm", frac,
+                                step=self._pod.get("step"))
+        elif kind == "ckpt":
+            self._pod["ckpt_saves"] += 1
+            if rec.get("enqueue_ms") is not None:
+                self._pod["ckpt_last_enqueue_ms"] = rec["enqueue_ms"]
+        elif kind == "ckpt_drain":
+            if rec.get("drain_ms") is not None:
+                self._pod["ckpt_drain_ms"] = rec["drain_ms"]
+        elif kind == "resume":
+            self._pod["resume"] = {
+                k: rec.get(k) for k in ("status", "source",
+                                        "resumed_from_step",
+                                        "requeue_attempt")}
+        elif kind == "stall_dump":
+            # the watchdog's last gasp: the worker MEASURED this many
+            # seconds without step progress before dumping — observe it
+            # directly so the alert is firing (and scrapeable) without
+            # waiting for this side's age accounting to catch up
+            pi = int(rec.get("process_index", 0) or 0)
+            stall_s = rec.get("stall_s")
+            if isinstance(stall_s, (int, float)) \
+                    and self.stall_timeout_s > 0:
+                self.engine.observe("stall", float(stall_s), host=pi,
+                                    step=rec.get("step"),
+                                    threshold=self.stall_timeout_s)
+        # kind == "alert" (our own loopback echo) and unknown kinds:
+        # counted, otherwise ignored
+
+    def _ingest_heartbeat(self, rec: Dict[str, Any], now: float) -> None:
+        pi = int(rec.get("process_index", 0) or 0)
+        h = self._hosts.setdefault(pi, {
+            "window": RollingWindow(self.window_s),
+            "last_progress": now, "last_seen": now, "step": None,
+            "epoch": None, "phase": None, "progress_n": None,
+            "hbm_peak_bytes": None,
+            "staging_overlap_fraction": None})
+        step = rec.get("step")
+        stepped = (isinstance(step, (int, float)) and step >= 0
+                   and h["step"] != int(step))
+        # stall re-arm: prefer the beacon's note_progress counter — the
+        # SAME any-progress signal the watchdog re-arms on (phase flips
+        # during a long eval or ckpt drain count, so those phases don't
+        # read as stalls) — falling back to step advances for scripted
+        # or older beacons that don't carry it
+        pn = rec.get("progress_n")
+        if pn is not None:
+            if h["progress_n"] != pn:
+                h["last_progress"] = now
+            h["progress_n"] = pn
+        elif stepped:
+            h["last_progress"] = now
+        if isinstance(step, (int, float)) and step >= 0:
+            if stepped:
+                h["window"].add(now, float(step))
+            h["step"] = int(step)
+        for k in ("epoch", "phase"):
+            if rec.get(k) is not None:
+                h[k] = rec[k]
+        if rec.get("hbm_peak_bytes") is not None:
+            h["hbm_peak_bytes"] = rec["hbm_peak_bytes"]
+        h["last_seen"] = now
+        # live staging overlap from the beacon's cheap counters: the
+        # SAME observable the exit verdict grades, available mid-run
+        run_s = rec.get("run_s")
+        wait_s = rec.get("staging_wait_s")
+        if (rec.get("staging_streamed")
+                and isinstance(run_s, (int, float)) and run_s > 0
+                and isinstance(wait_s, (int, float))):
+            ov = max(0.0, min(1.0, 1.0 - wait_s / run_s))
+            h["staging_overlap_fraction"] = ov
+            self.engine.observe("staging", ov, host=pi, step=h["step"])
+
+    def _observe_rate(self, sps: Any) -> None:
+        if not isinstance(sps, (int, float)) or sps <= 0:
+            return   # warmup/empty timer: nothing measured yet
+        self._pod["steps_per_sec"] = sps
+        if self.regress_baseline_sps:
+            self.engine.observe("regress",
+                                sps / self.regress_baseline_sps,
+                                step=self._pod.get("step"))
+
+    # ------------------------------------------------------------ tick
+    def tick(self, now: Optional[float] = None) -> None:
+        """Time-based rule evaluation: per-host progress ages (stall)
+        and the live straggler ratio from beacon-derived rates."""
+        now = self.clock() if now is None else now
+        with self._lock:
+            import statistics
+            step_times = []
+            for pi, h in self._hosts.items():
+                age = max(0.0, now - h["last_progress"])
+                h["age_s"] = age
+                if self.stall_timeout_s > 0:
+                    # the per-RUN stall window (--stall-timeout-s), not
+                    # the env-only rules resolve: live and the watchdog
+                    # must agree on when a host counts as wedged (0 =
+                    # disabled, same contract as the watchdog)
+                    self.engine.observe("stall", age, host=pi,
+                                        step=h.get("step"),
+                                        threshold=self.stall_timeout_s)
+                r = h["window"].rate()
+                if r and r > 0 and now - h["last_seen"] < self.window_s:
+                    step_times.append(1.0 / r)
+            if len(step_times) >= 2:
+                med = statistics.median(step_times)
+                if med > 0:
+                    self.engine.observe("straggler",
+                                        max(step_times) / med,
+                                        step=self._pod.get("step"))
+        self._rebuild()
+
+    def _tick_loop(self, period: float) -> None:
+        while not self._stop.wait(period):
+            try:
+                self.tick()
+            except Exception:
+                pass   # the view must never take down the run
+
+    # ---------------------------------------------------------- status
+    def _on_event(self, rec: Dict[str, Any]) -> None:
+        """Alert transition fan-out: alerts.jsonl + the metrics stream
+        (rank 0's buffered JSONL — the report CLI's Alerts section reads
+        both) + an immediate forced status rewrite so the breach is on
+        disk and scrapeable NOW, not at the next throttled write."""
+        try:
+            if self._alerts_fh is None:
+                self._alerts_fh = open(self.alerts_path, "a")
+            self._alerts_fh.write(json.dumps(rec, default=str) + "\n")
+            self._alerts_fh.flush()
+        except Exception:
+            pass
+        if self.metrics is not None:
+            try:
+                self.metrics.log(**rec)
+            except Exception:
+                pass
+        self._rebuild(force_write=True)
+
+    def _rebuild(self, force_write: bool = False) -> None:
+        with self._lock:
+            hosts = {}
+            for pi, h in sorted(self._hosts.items()):
+                hosts[str(pi)] = {
+                    "step": h["step"], "epoch": h["epoch"],
+                    "phase": h["phase"],
+                    "steps_per_sec": h["window"].rate(),
+                    "age_s": round(h.get("age_s", 0.0), 3),
+                    "hbm_peak_bytes": h["hbm_peak_bytes"],
+                    "staging_overlap_fraction":
+                        h["staging_overlap_fraction"]}
+            alerts = self.engine.snapshot()
+            doc = {
+                "schema": LIVE_SCHEMA_VERSION,
+                "run_id": self.run_id,
+                "requeue_attempt": self.requeue_attempt,
+                "ts": self.wall(),
+                "status": "alert" if alerts["firing"] else "ok",
+                "pod": dict(self._pod,
+                            steps_per_sec_window=self._pod_window.rate()),
+                "hosts": hosts,
+                "alerts": alerts,
+                "counters": {"records": self.records,
+                             "bad_frames": self.bad_frames},
+            }
+        self._status = doc
+        now = self.clock()
+        with self._write_lock:
+            if force_write or now - self._last_write >= \
+                    self.status_min_interval_s:
+                self._last_write = now
+                self._write_status(doc)
+
+    def _write_status(self, doc: Dict[str, Any]) -> None:
+        try:
+            tmp = f"{self.status_path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(doc, f, default=str)
+            os.replace(tmp, self.status_path)
+        except Exception:
+            pass   # a full disk must not kill the aggregator
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The last built status doc. LOCK-FREE by design (a wholesale-
+        replaced reference): the exporter's scrape handler and the
+        flight recorder's stall dump both read it while the run may be
+        wedged — neither can afford to wait on the ingest lock."""
+        return self._status
+
+    # ------------------------------------------------------ networking
+    def serve_ingest(self, host: str = "127.0.0.1",
+                     port: int = 0) -> int:
+        """Bind the ingest listener (TCP stream + UDP datagrams on the
+        same port number) and start the accept/receive threads; returns
+        the bound port (``port=0`` picks an ephemeral one)."""
+        tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        tcp.bind((host, port))
+        tcp.listen(32)
+        bound = tcp.getsockname()[1]
+        udp = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            udp.bind((host, bound))
+        except OSError:
+            udp = None
+        self._servers += [s for s in (tcp, udp) if s is not None]
+        t = threading.Thread(target=self._accept_loop, args=(tcp,),
+                             name="tpudist-live-tcp", daemon=True)
+        t.start()
+        self._threads.append(t)
+        if udp is not None:
+            tu = threading.Thread(target=self._udp_loop, args=(udp,),
+                                  name="tpudist-live-udp", daemon=True)
+            tu.start()
+            self._threads.append(tu)
+        return bound
+
+    def _accept_loop(self, tcp: socket.socket) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = tcp.accept()
+            except OSError:
+                return   # listener closed
+            self._conns.append(conn)
+            t = threading.Thread(target=self._conn_loop, args=(conn,),
+                                 name="tpudist-live-conn", daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _conn_loop(self, conn: socket.socket) -> None:
+        dec = FrameDecoder()
+        try:
+            while not self._stop.is_set():
+                data = conn.recv(65536)
+                if not data:
+                    break
+                for rec in dec.feed(data):
+                    self.ingest(rec)
+                self.bad_frames += dec.bad
+                dec.bad = 0
+        except Exception:
+            pass
+        finally:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _udp_loop(self, udp: socket.socket) -> None:
+        while not self._stop.is_set():
+            try:
+                data, _ = udp.recvfrom(65536)
+            except OSError:
+                return
+            dec = FrameDecoder()
+            for rec in dec.feed(data):
+                self.ingest(rec)
+            self.bad_frames += dec.bad
+
+    def close(self) -> None:
+        """Final status write + teardown. Deliberately NO stall
+        evaluation here: a run in orderly shutdown is not stalled."""
+        self._stop.set()
+        for s in self._servers:
+            try:
+                s.close()
+            except Exception:
+                pass
+        # unblock the per-connection reader threads too: a thread parked
+        # in recv() on a still-open worker connection would otherwise
+        # eat its full join timeout below — shutdown must stay O(1), not
+        # O(workers)
+        for c in self._conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+        self._rebuild(force_write=True)
+        if self._alerts_fh is not None:
+            try:
+                self._alerts_fh.close()
+            except Exception:
+                pass
+            self._alerts_fh = None
+        for t in self._threads:
+            t.join(timeout=1.0)
+
+
+# --------------------------------------------------- prometheus text
+
+_PROM_HELP = {
+    "tpudist_up": "Live aggregator is running.",
+    "tpudist_info": "Run identity (labels carry run_id and attempt).",
+    "tpudist_step": "Last global step seen on the metrics stream.",
+    "tpudist_epoch": "Last epoch seen on the metrics stream.",
+    "tpudist_steps_per_sec": "Pod steps/s (last measured).",
+    "tpudist_steps_per_sec_window": "Pod steps/s over the rolling "
+                                    "window.",
+    "tpudist_loss": "Last training loss.",
+    "tpudist_staging_overlap_fraction": "Staging overlap fraction "
+                                        "(1.0 = all H2D hidden).",
+    "tpudist_exposed_comm_fraction": "Exposed-communication fraction "
+                                     "of the device window.",
+    "tpudist_straggler_ratio": "Worst host step time over pod median.",
+    "tpudist_ckpt_last_enqueue_ms": "Last checkpoint enqueue cost.",
+    "tpudist_ckpt_drain_ms": "Run-total checkpoint drain cost.",
+    "tpudist_host_step": "Per-host last step from its heartbeat.",
+    "tpudist_host_steps_per_sec": "Per-host rolling step rate.",
+    "tpudist_host_progress_age_seconds": "Seconds since the host's "
+                                         "step last advanced.",
+    "tpudist_host_hbm_peak_bytes": "Per-host HBM high-water mark.",
+    "tpudist_alert_firing": "1 while the named alert rule fires.",
+    "tpudist_alerts_total": "Alert fire/resolve transitions so far.",
+    "tpudist_records_total": "Telemetry records ingested.",
+    "tpudist_bad_frames_total": "Undecodable frames dropped.",
+}
+
+
+def _prom_escape(v: Any) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def _prom_num(v: Any) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return f"{f:.10g}"
+
+
+def prometheus_text(status: Dict[str, Any]) -> str:
+    """Render a status doc (:meth:`LiveAggregator.snapshot`) as
+    Prometheus text exposition format (version 0.0.4). Pure function —
+    the golden test pins the exact output for a scripted status."""
+    out: List[str] = []
+
+    def metric(name: str, samples: List[Tuple[Dict[str, str], Any]],
+               mtype: str = "gauge") -> None:
+        rows = [(lbl, v) for lbl, v in samples if v is not None]
+        if not rows:
+            return
+        out.append(f"# HELP {name} {_PROM_HELP[name]}")
+        out.append(f"# TYPE {name} {mtype}")
+        for lbl, v in rows:
+            label_s = ",".join(f'{k}="{_prom_escape(x)}"'
+                               for k, x in lbl.items())
+            out.append(f"{name}{{{label_s}}} {_prom_num(v)}"
+                       if label_s else f"{name} {_prom_num(v)}")
+
+    pod = status.get("pod", {})
+    hosts = status.get("hosts", {})
+    alerts = status.get("alerts", {})
+    counters = status.get("counters", {})
+    metric("tpudist_up", [({}, 1)])
+    metric("tpudist_info", [({"run_id": status.get("run_id") or "",
+                              "requeue_attempt":
+                                  str(status.get("requeue_attempt", 0))},
+                             1)])
+    metric("tpudist_step", [({}, pod.get("step"))])
+    metric("tpudist_epoch", [({}, pod.get("epoch"))])
+    metric("tpudist_steps_per_sec", [({}, pod.get("steps_per_sec"))])
+    metric("tpudist_steps_per_sec_window",
+           [({}, pod.get("steps_per_sec_window"))])
+    metric("tpudist_loss", [({}, pod.get("loss"))])
+    metric("tpudist_staging_overlap_fraction",
+           [({}, pod.get("staging_overlap_fraction"))])
+    metric("tpudist_exposed_comm_fraction",
+           [({}, pod.get("exposed_comm_frac"))])
+    metric("tpudist_straggler_ratio",
+           [({}, pod.get("straggler_ratio"))])
+    metric("tpudist_ckpt_last_enqueue_ms",
+           [({}, pod.get("ckpt_last_enqueue_ms"))])
+    metric("tpudist_ckpt_drain_ms", [({}, pod.get("ckpt_drain_ms"))])
+    metric("tpudist_host_step",
+           [({"host": pi}, h.get("step")) for pi, h in hosts.items()])
+    metric("tpudist_host_steps_per_sec",
+           [({"host": pi}, h.get("steps_per_sec"))
+            for pi, h in hosts.items()])
+    metric("tpudist_host_progress_age_seconds",
+           [({"host": pi}, h.get("age_s")) for pi, h in hosts.items()])
+    metric("tpudist_host_hbm_peak_bytes",
+           [({"host": pi}, h.get("hbm_peak_bytes"))
+            for pi, h in hosts.items()])
+    # one series per alert RULE: 1 when any (rule, host) key fires —
+    # a fixed label set scrapers can alert on without knowing hosts
+    firing_rules = {a["alert"] for a in alerts.get("firing", [])}
+    metric("tpudist_alert_firing",
+           [({"alert": r.name}, 1 if r.name in firing_rules else 0)
+            for r in rules_lib.ALERT_RULES])
+    metric("tpudist_alerts_total", [({}, alerts.get("events", 0))],
+           mtype="counter")
+    metric("tpudist_records_total", [({}, counters.get("records", 0))],
+           mtype="counter")
+    metric("tpudist_bad_frames_total",
+           [({}, counters.get("bad_frames", 0))], mtype="counter")
+    return "\n".join(out) + "\n"
+
+
+# -------------------------------------------------------- http exporter
+
+
+class LiveHttpServer:
+    """Stdlib HTTP front of the aggregator: ``/metrics`` (Prometheus
+    text format), ``/status.json`` (the raw snapshot — the tail CLI's
+    source), ``/healthz``. Handlers read only
+    :meth:`LiveAggregator.snapshot` — no lock shared with ingest."""
+
+    def __init__(self, aggregator: LiveAggregator, *, port: int = 0,
+                 host: str = "127.0.0.1"):
+        import http.server
+
+        agg = aggregator
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):   # noqa: N802 (stdlib API name)
+                if self.path.split("?")[0] in ("/metrics", "/metrics/"):
+                    body = prometheus_text(agg.snapshot()).encode()
+                    ctype = ("text/plain; version=0.0.4; "
+                             "charset=utf-8")
+                elif self.path.split("?")[0] == "/status.json":
+                    body = json.dumps(agg.snapshot(),
+                                      default=str).encode()
+                    ctype = "application/json"
+                elif self.path.split("?")[0] == "/healthz":
+                    body = b'{"ok": true}'
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):   # scrapes must not spam stdout
+                pass
+
+        self._server = http.server.ThreadingHTTPServer((host, port),
+                                                       Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.host = host
+        self._thread = threading.Thread(
+            target=self._server.serve_forever,
+            name="tpudist-live-http", daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        try:
+            self._server.shutdown()
+            self._server.server_close()
+        except Exception:
+            pass
+        self._thread.join(timeout=2.0)
+
+
+# ------------------------------------------------------------- run id
+
+
+def resolve_run_id(process_count: int = 1) -> str:
+    """The run's correlation id: ``$TPUDIST_RUN_ID`` when the launcher
+    set one (it does — the SAME id then spans every requeue attempt),
+    else coordinator-generated and broadcast at init so every worker
+    stamps identical artifacts. Lazy jax import: the single-process and
+    env paths stay usable from jax-free tooling."""
+    rid = os.environ.get("TPUDIST_RUN_ID")
+    if rid:
+        return rid.strip()[:64]
+    import uuid
+    rid = uuid.uuid4().hex[:12]
+    if process_count <= 1:
+        return rid
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import multihost_utils
+    row = np.frombuffer(rid.encode("ascii"), np.uint8)
+    rows = np.asarray(multihost_utils.process_allgather(
+        jnp.asarray(row))).reshape(process_count, -1)
+    return rows[0].tobytes().decode("ascii")
+
+
+# ------------------------------------------------------------ run facade
+
+
+class LiveRun:
+    """The train loop's one live-telemetry handle: the coordinator gets
+    the aggregator + HTTP exporter, every process gets an emitter back
+    to the coordinator. ``--live off`` never constructs one — the
+    disabled path is the absence of this object."""
+
+    def __init__(self, *, aggregator: Optional[LiveAggregator] = None,
+                 exporter: Optional[LiveHttpServer] = None,
+                 emitter: Optional[TelemetryEmitter] = None,
+                 endpoint: Optional[str] = None):
+        self.aggregator = aggregator
+        self.exporter = exporter
+        self.emitter = emitter
+        self.endpoint = endpoint
+
+    @classmethod
+    def start(cls, *, is_coordinator: bool, process_index: int,
+              out_dir: str, run_id: Optional[str] = None,
+              requeue_attempt: int = 0, port: int = 0,
+              endpoint: Optional[str] = None,
+              stall_timeout_s: Optional[float] = None,
+              metrics: Any = None) -> "LiveRun":
+        """Wire this process's live pieces. With no explicit endpoint
+        (single-host runs, CI) the coordinator binds loopback on an
+        ephemeral port and talks to itself — the SAME socket path a pod
+        exercises, not a shortcut. The launcher passes
+        ``TPUDIST_LIVE_ENDPOINT=<coordinator>:<port>`` so workers on
+        other hosts reach the aggregator; the coordinator then binds
+        all interfaces on that port."""
+        aggregator = exporter = emitter = None
+        if is_coordinator:
+            aggregator = LiveAggregator(
+                out_dir=out_dir, run_id=run_id,
+                requeue_attempt=requeue_attempt,
+                stall_timeout_s=stall_timeout_s, metrics=metrics)
+            bind_host, bind_port = "127.0.0.1", 0
+            if endpoint:
+                _, (_, bind_port) = parse_endpoint(endpoint)
+                bind_host = "0.0.0.0"
+            actual = aggregator.serve_ingest(host=bind_host,
+                                             port=bind_port)
+            exporter = LiveHttpServer(
+                aggregator, port=port,
+                host="0.0.0.0" if endpoint else "127.0.0.1")
+            if not endpoint:
+                endpoint = f"127.0.0.1:{actual}"
+        if endpoint:
+            emitter = TelemetryEmitter(endpoint)
+        return cls(aggregator=aggregator, exporter=exporter,
+                   emitter=emitter, endpoint=endpoint)
+
+    def emit(self, rec: Dict[str, Any]) -> None:
+        if self.emitter is not None:
+            self.emitter.emit(rec)
+
+    def snapshot_fields(self) -> Optional[Dict[str, Any]]:
+        """The aggregator's last rolling-window snapshot, for the
+        flight recorder's pre-kill dump (lock-free — see
+        :meth:`LiveAggregator.snapshot`); None off-coordinator."""
+        if self.aggregator is None:
+            return None
+        return self.aggregator.snapshot()
+
+    def close(self, drain_s: float = 1.0) -> None:
+        """Emitter drain first (its tail records must reach the
+        aggregator), then a short settle for in-flight frames, then the
+        aggregator's final status write. Every wait is bounded: run
+        exit must not hang on telemetry."""
+        if self.emitter is not None:
+            self.emitter.close(drain_s=drain_s)
+        if self.aggregator is not None:
+            deadline = time.monotonic() + drain_s
+            seen = -1
+            while time.monotonic() < deadline:
+                n = self.aggregator.records
+                if n == seen:
+                    break
+                seen = n
+                time.sleep(0.05)
+            self.aggregator.close()
+        if self.exporter is not None:
+            self.exporter.close()
+
+
+# ------------------------------------------------------------- tail CLI
+
+
+def render_status(status: Dict[str, Any]) -> str:
+    """The terminal dashboard body for one status doc. Pure text (the
+    tail loop adds the screen-clear), pinned by the CLI e2e test."""
+    import datetime
+    pod = status.get("pod", {})
+    alerts = status.get("alerts", {})
+    ts = status.get("ts")
+    when = (datetime.datetime.fromtimestamp(ts).strftime(
+        "%Y-%m-%d %H:%M:%S") if ts else "-")
+    lines = [
+        f"tpudist live · run {status.get('run_id') or '?'} · attempt "
+        f"{status.get('requeue_attempt', 0)} · status "
+        f"{(status.get('status') or '?').upper()} · {when}"]
+
+    def fmt(v, spec="{:.2f}", none="-"):
+        return spec.format(v) if isinstance(v, (int, float)) else none
+
+    lines.append(
+        f"pod: step {pod.get('step') if pod.get('step') is not None else '-'}"
+        f" epoch {pod.get('epoch') if pod.get('epoch') is not None else '-'}"
+        f" · {fmt(pod.get('steps_per_sec'))} steps/s"
+        f" · loss {fmt(pod.get('loss'), '{:.4f}')}"
+        f" · staging overlap {fmt(pod.get('staging_overlap_fraction'))}"
+        f" · exposed comm {fmt(pod.get('exposed_comm_frac'), '{:.1%}')}")
+    hosts = status.get("hosts", {})
+    if hosts:
+        lines.append(f"{'host':>4}  {'step':>8}  {'epoch':>5}  "
+                     f"{'phase':<10} {'steps/s':>8}  {'age':>6}")
+        for pi, h in sorted(hosts.items(), key=lambda kv: int(kv[0])):
+            lines.append(
+                f"{pi:>4}  "
+                f"{h.get('step') if h.get('step') is not None else '-':>8}  "
+                f"{h.get('epoch') if h.get('epoch') is not None else '-':>5}"
+                f"  {h.get('phase') or '-':<10} "
+                f"{fmt(h.get('steps_per_sec')):>8}  "
+                f"{fmt(h.get('age_s'), '{:.1f}s'):>6}")
+    firing = alerts.get("firing", [])
+    if firing:
+        lines.append("ALERTS FIRING:")
+        for a in firing:
+            host = f" host{a['host']}" if a.get("host") is not None else ""
+            lines.append(
+                f"  [{a['alert']}]{host} value {a.get('value'):.4g} vs "
+                f"threshold {a.get('threshold'):.4g} "
+                f"(for {a.get('duration_s', 0):.1f}s, since step "
+                f"{a.get('first_step')})")
+    else:
+        lines.append("alerts: none firing")
+    resolved = [a for a in alerts.get("history", [])
+                if a.get("state") == alerts_lib.RESOLVED]
+    for a in resolved[-3:]:
+        host = f" host{a['host']}" if a.get("host") is not None else ""
+        lines.append(f"  [resolved] {a['alert']}{host}: fired at step "
+                     f"{a.get('first_step')}, lasted "
+                     f"{a.get('duration_s', 0):.1f}s")
+    return "\n".join(lines)
+
+
+def _fetch_status(status_path: Optional[str],
+                  url: Optional[str]) -> Optional[Dict[str, Any]]:
+    if url:
+        import urllib.request
+        try:
+            with urllib.request.urlopen(url, timeout=2.0) as r:
+                return json.loads(r.read())
+        except Exception:
+            return None
+    try:
+        with open(status_path or STATUS_NAME) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    import sys
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.obs.live",
+        description="live pod telemetry tools (jax-free)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    tail = sub.add_parser(
+        "tail", help="terminal dashboard over live_status.json or the "
+                     "aggregator's /status.json")
+    tail.add_argument("--status", type=str, default=None,
+                      help=f"status file to render (default: "
+                           f"./{STATUS_NAME})")
+    tail.add_argument("--url", type=str, default=None,
+                      help="poll the aggregator instead, e.g. "
+                           "http://coordinator:9109/status.json")
+    tail.add_argument("--interval", type=float, default=2.0,
+                      help="refresh period in seconds (default 2)")
+    tail.add_argument("--once", action="store_true",
+                      help="render one frame and exit (scripts/tests)")
+    args = p.parse_args(argv)
+
+    if args.cmd == "tail":
+        while True:
+            status = _fetch_status(args.status, args.url)
+            if status is None:
+                src = args.url or args.status or STATUS_NAME
+                print(f"tpudist.obs.live: no status at {src}",
+                      file=sys.stderr)
+                if args.once:
+                    return 2
+            else:
+                if not args.once and sys.stdout.isatty():
+                    print("\x1b[2J\x1b[H", end="")
+                print(render_status(status), flush=True)
+                if args.once:
+                    return 0
+            time.sleep(args.interval)
+    return 2
+
+
+if __name__ == "__main__":
+    import sys
+    sys.exit(main())

@@ -40,9 +40,10 @@ import os
 import sys
 from typing import Any, Dict, List, Optional, Sequence
 
+from tpudist import rules as rules_lib
 from tpudist.obs import devtime as devtime_mod
 
-REPORT_SCHEMA_VERSION = 2
+REPORT_SCHEMA_VERSION = 3
 
 SUCCESS = "success"
 FAIL = "fail"
@@ -50,8 +51,10 @@ UNGATEABLE = "ungateable"
 
 # Regression gate: measured steps/s below this fraction of baseline is
 # a FAIL. Same advisory three-valued shape as the staging/straggler
-# gates; override via --regress-min or TPUDIST_REGRESS_MIN.
-REGRESS_MIN_FRACTION = 0.8
+# gates; override via --regress-min or TPUDIST_REGRESS_MIN. The value
+# lives in tpudist.rules, shared with the live alert engine's regress
+# rule so mid-run and offline grading cannot drift.
+REGRESS_MIN_FRACTION = rules_lib.REGRESS_MIN_FRACTION
 
 # A host whose per-phase self time exceeds the pod median by this many
 # seconds AND this factor is attributed as a straggler cause.
@@ -414,6 +417,83 @@ def collectives_section(doc: Optional[Dict]) -> Optional[Dict[str, Any]]:
     }
 
 
+# At-exit fail verdicts and the live alert rule that should have fired
+# for each — the Alerts section's cross-check table. The whole point of
+# on-line alerting is that a run which grades fail at exit alerted
+# HOURS earlier; a fail with no matching mid-run alert is a gap in the
+# live engine's coverage and gets flagged as a report warning.
+_EXIT_FAIL_TO_RULE = (
+    ("staging_status", "staging"),
+    ("straggler_status", "straggler"),
+    ("comm_status", "comm"),
+)
+
+
+def alerts_section(metrics: List[Dict[str, Any]],
+                   alert_history: Optional[List[Dict[str, Any]]],
+                   timing: Optional[Dict]) -> Dict[str, Any]:
+    """The live-telemetry slice of the report: the alert fire/resolve
+    history (first-fire step/time, duration, final state per
+    ``(rule, host)``) plus the on-line/at-exit parity cross-check.
+
+    ``alert_history`` comes from ``alerts.jsonl`` (the aggregator's
+    append-only transition log) or ``live_status.json``; runs without
+    the live bus fall back to the ``kind=alert`` records the aggregator
+    mirrored into ``metrics.jsonl``; a run with neither reads as
+    ``enabled: False`` and skips the cross-check (nothing was watching,
+    so a miss means nothing)."""
+    history = list(alert_history or [])
+    live_seen = alert_history is not None
+    if not history:
+        history = [r for r in metrics if r.get("kind") == "alert"]
+        live_seen = live_seen or bool(history)
+    # fold transitions into one row per (rule, host): the FIRING event
+    # pins first_step/first_ts; the latest transition wins the rest
+    rows: Dict[tuple, Dict[str, Any]] = {}
+    for rec in history:
+        rule = rec.get("alert")
+        if not rule:
+            continue
+        key = (rule, rec.get("host"))
+        row = rows.setdefault(key, {
+            "alert": rule, "host": rec.get("host"),
+            "first_step": rec.get("first_step"),
+            "first_ts": rec.get("first_ts"),
+            "state": rec.get("state"), "duration_s": 0.0,
+            "value": rec.get("value"),
+            "threshold": rec.get("threshold")})
+        row["state"] = rec.get("state", row["state"])
+        for k in ("value", "threshold"):
+            if rec.get(k) is not None:
+                row[k] = rec[k]
+        if rec.get("duration_s") is not None:
+            row["duration_s"] = max(row["duration_s"],
+                                    float(rec["duration_s"]))
+    fired_rules = {r["alert"] for r in rows.values()}
+    warnings = []
+    if live_seen:
+        for status_key, rule in _EXIT_FAIL_TO_RULE:
+            if (timing or {}).get(status_key) == FAIL \
+                    and rule not in fired_rules:
+                warnings.append(
+                    f"at-exit {status_key}=fail had NO mid-run "
+                    f"{rule!r} alert — live coverage gap")
+        # a watchdog stall dump in the stream means the run wedged;
+        # the live stall alert must have fired before the kill
+        if any(r.get("kind") == "stall_dump" for r in metrics) \
+                and "stall" not in fired_rules:
+            warnings.append("watchdog stall dump recorded but NO "
+                            "mid-run 'stall' alert fired")
+    return {
+        "enabled": live_seen,
+        "events": len(history),
+        "history": sorted(rows.values(),
+                          key=lambda r: (r.get("first_ts") or 0)),
+        "fired_rules": sorted(fired_rules),
+        "warnings": warnings,
+    }
+
+
 def straggler_section(hosts: Dict[int, Dict[str, Any]],
                       metrics) -> Dict[str, Any]:
     """Straggler attribution BY PHASE: for each host, which phase's
@@ -488,13 +568,13 @@ def build_report(metrics: List[Dict[str, Any]],
                  trace_doc: Dict[str, Any], *,
                  baseline: Optional[Dict] = None,
                  regress_min: Optional[float] = None,
-                 collectives: Optional[Dict] = None) -> Dict[str, Any]:
+                 collectives: Optional[Dict] = None,
+                 alert_history: Optional[List[Dict]] = None
+                 ) -> Dict[str, Any]:
     if regress_min is None:
-        try:
-            regress_min = float(os.environ.get(
-                "TPUDIST_REGRESS_MIN", REGRESS_MIN_FRACTION))
-        except ValueError:
-            regress_min = REGRESS_MIN_FRACTION
+        # the shared rules table (same env knob, read at call time, as
+        # the live alert engine's regress rule)
+        regress_min = rules_lib.resolve("regress")
     all_events = complete_events(trace_doc)
     # the host-side analyses must not see the device tracks: a device
     # busy interval is not a host phase, and folding it into self-time
@@ -512,6 +592,12 @@ def build_report(metrics: List[Dict[str, Any]],
     regression = regression_section(timing, baseline, regress_min)
     stragglers = straggler_section(hosts, metrics)
     devtime = devtime_section(all_events, metrics, baseline)
+    alerts = alerts_section(metrics, alert_history, timing)
+    # the correlation id: every metrics record carries it (the train
+    # CLI stamps MetricsLogger.extra); older artifacts fall back to the
+    # trace metadata
+    run_id = next((r.get("run_id") for r in metrics if r.get("run_id")),
+                  None) or trace_doc.get("metadata", {}).get("run_id")
     # pod-level phase totals (sum over hosts)
     pod_phases: Dict[str, float] = {}
     for h in hosts.values():
@@ -527,6 +613,7 @@ def build_report(metrics: List[Dict[str, Any]],
     return {
         "schema": REPORT_SCHEMA_VERSION,
         "run": {
+            "run_id": run_id,
             "steps": timing.get("steps") if timing else None,
             "run_s": timing.get("run_s") if timing else None,
             "compile_warmup_s": (timing.get("compile_warmup_s")
@@ -567,6 +654,7 @@ def build_report(metrics: List[Dict[str, Any]],
         "collectives": collectives_section(collectives),
         "stragglers": stragglers,
         "regression": regression,
+        "alerts": alerts,
         "verdict": verdict,
     }
 
@@ -576,6 +664,11 @@ def to_markdown(report: Dict[str, Any]) -> str:
     r = report
     lines = ["# tpudist run report", ""]
     run = r["run"]
+    if run.get("run_id"):
+        att = run.get("requeue_attempt")
+        lines += [f"_run {run['run_id']}"
+                  + (f" · requeue attempt {att}" if att else "") + "_",
+                  ""]
     lines += [f"**Verdict: {r['verdict']}** — regression "
               f"{r['regression']['status']}, stragglers "
               f"{r['stragglers']['status']}, staging "
@@ -678,6 +771,32 @@ def to_markdown(report: Dict[str, Any]) -> str:
                 + (f"{pct:.1f}" if pct is not None else "—")
                 + f" | {k.get('message_bytes')} |")
         lines.append("")
+    al = r.get("alerts") or {}
+    if al.get("enabled"):
+        lines += ["## Alerts (live telemetry)", ""]
+        if al["history"]:
+            lines += ["| rule | host | first fired | duration | state "
+                      "| value vs threshold |",
+                      "|---|---|---|---|---|---|"]
+            for a in al["history"]:
+                host = a["host"] if a.get("host") is not None else "pod"
+                first = (f"step {a['first_step']}"
+                         if a.get("first_step") is not None else "—")
+                val = (f"{a['value']:.4g} vs {a['threshold']:.4g}"
+                       if isinstance(a.get("value"), (int, float))
+                       and isinstance(a.get("threshold"), (int, float))
+                       else "—")
+                lines.append(
+                    f"| {a['alert']} | {host} | {first} | "
+                    f"{a.get('duration_s', 0):.1f}s | {a.get('state')} "
+                    f"| {val} |")
+            lines.append("")
+        else:
+            lines += ["- no alerts fired", ""]
+        for w in al.get("warnings", []):
+            lines.append(f"- ⚠️ {w}")
+        if al.get("warnings"):
+            lines.append("")
     if r["stragglers"]["attribution"]:
         lines += ["## Straggler attribution", ""]
         for a in r["stragglers"]["attribution"]:
@@ -718,6 +837,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "--collective-sweep) folded into the report's "
                         "Collectives section (default: <run-dir>/"
                         "BENCH_COLLECTIVES.json when present)")
+    p.add_argument("--alerts", type=str, default=None,
+                   help="alert history for the Alerts section: "
+                        "alerts.jsonl (the live aggregator's transition "
+                        "log) or a live_status.json (default: <run-dir>/"
+                        "alerts.jsonl, else <run-dir>/live_status.json "
+                        "when present)")
     p.add_argument("--regress-min", type=float, default=None,
                    help=f"regression floor as a fraction of baseline "
                         f"steps/s (default $TPUDIST_REGRESS_MIN, else "
@@ -763,9 +888,32 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{coll_path}", file=sys.stderr)
         return 2
 
+    alert_history = None
+    alerts_path = args.alerts
+    if alerts_path is None:
+        for cand in (os.path.join(run_dir, "alerts.jsonl"),
+                     os.path.join(run_dir, "live_status.json")):
+            if os.path.exists(cand):
+                alerts_path = cand
+                break
+    if alerts_path:
+        if not os.path.exists(alerts_path):
+            print(f"tpudist.obs.report: missing alerts file "
+                  f"{alerts_path}", file=sys.stderr)
+            return 2
+        with open(alerts_path) as f:
+            if alerts_path.endswith(".jsonl"):
+                alert_history = [json.loads(line)
+                                 for line in f if line.strip()]
+            else:
+                # a live_status.json: the final snapshot's full history
+                alert_history = (json.load(f).get("alerts") or {}).get(
+                    "history", [])
+
     report = build_report(metrics, trace_doc, baseline=baseline,
                           regress_min=args.regress_min,
-                          collectives=collectives)
+                          collectives=collectives,
+                          alert_history=alert_history)
     out_json = args.out_json or os.path.join(run_dir, "run_report.json")
     out_md = args.out_md or os.path.join(run_dir, "run_report.md")
     for path, payload in ((out_json, json.dumps(report, indent=1)),

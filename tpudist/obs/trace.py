@@ -148,6 +148,11 @@ class Tracer:
         self._bufs: List[_ThreadBuf] = []
         self._lock = threading.Lock()
         self.exported = False      # run-end export happened (any form)
+        # run identity stamped into every exported document's metadata
+        # (run_id / requeue_attempt — the train CLI sets it once the
+        # coordinator has broadcast the id), so a trace scp'd off a
+        # dead pod names the attempt it came from
+        self.run_info: Dict[str, Any] = {}
         # wall↔monotonic correspondence, sampled back-to-back: lets the
         # offline report align metrics.jsonl (wall ts + mono) with span
         # timestamps without trusting NTP for intervals
@@ -269,6 +274,7 @@ class Tracer:
                 "dropped": self.dropped,
                 "clock_sync": {"wall_ts": self.wall_at_start,
                                "mono_us": self.mono_ns_at_start / 1e3},
+                **self.run_info,
             },
         }
 
@@ -427,18 +433,27 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
         device_tracks += int(meta.get("device_tracks", 0))
         clock_sync[str(i)] = meta.get("clock_sync")
     events.sort(key=lambda e: (e.get("ts", -1.0)))
+    metadata = {
+        "schema": TRACE_SCHEMA_VERSION,
+        "hosts": len(worker_docs),
+        "clock_offsets_ns": [int(o) for o in offsets_ns],
+        "clock_sync": clock_sync,
+        "spans": spans,
+        "dropped": dropped,
+        "device_tracks": device_tracks,
+    }
+    # run identity: every worker stamped the same broadcast id; the
+    # first doc that carries one names the merged artifact too
+    for key in ("run_id", "requeue_attempt"):
+        for doc in worker_docs:
+            v = doc.get("metadata", {}).get(key)
+            if v is not None:
+                metadata[key] = v
+                break
     return {
         "displayTimeUnit": "ms",
         "traceEvents": events,
-        "metadata": {
-            "schema": TRACE_SCHEMA_VERSION,
-            "hosts": len(worker_docs),
-            "clock_offsets_ns": [int(o) for o in offsets_ns],
-            "clock_sync": clock_sync,
-            "spans": spans,
-            "dropped": dropped,
-            "device_tracks": device_tracks,
-        },
+        "metadata": metadata,
     }
 
 

@@ -1,0 +1,143 @@
+"""The gate thresholds, in ONE place: at-exit grading and live alerts.
+
+Before this module existed every threshold lived next to its consumer —
+``STAGING_OVERLAP_MIN``/``STRAGGLER_FACTOR`` in :mod:`tpudist.verdict`,
+``COMM_EXPOSED_MAX`` in :mod:`tpudist.obs.devtime`,
+``REGRESS_MIN_FRACTION`` in :mod:`tpudist.obs.report`, the stall window
+in :mod:`tpudist.config` — which was fine while each gate had exactly
+one consumer. The live alert engine (:mod:`tpudist.obs.alerts`) is a
+SECOND consumer of every one of them, and the whole point of on-line
+alerting is that a run that will grade ``fail`` at exit must have
+alerted mid-run: the two graders evaluating *different* thresholds
+would silently break that contract. So the thresholds live here, both
+graders import them, and a tier-1 test diffs the two consumers against
+this table so they cannot drift apart again.
+
+Stdlib-only by design: this module sits under every jax-free offline
+path (verdict ← hoststats ← obs.report; obs.live's exporter and tail
+CLI) — it must import on a laptop with nothing installed.
+
+Each threshold's env override is read at CALL time (``resolve()``), not
+import time, so per-run overrides and tests take effect without a
+module reload — the discipline every gate already followed. A malformed
+env value reads as the default (an advisory observability knob must
+never kill a run at startup).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+# The canonical defaults (moved verbatim from their original homes —
+# the rationale comments stay with the consumers that explain them).
+STRAGGLER_FACTOR = 1.25     # verdict.straggler_status
+STAGING_OVERLAP_MIN = 0.5   # verdict.staging_status
+COMM_EXPOSED_MAX = 0.25     # obs.devtime.comm_status
+REGRESS_MIN_FRACTION = 0.8  # obs.report regression gate
+STALL_TIMEOUT_S = 300.0     # obs.heartbeat watchdog / live stall alert
+TRACE_DROP_MAX = 0.5        # verdict.trace_status (no live alert: a
+#                             dropped-span ratio is an artifact-quality
+#                             finding, not a mid-run health signal)
+
+
+@dataclass(frozen=True)
+class Threshold:
+    """One gate: its env knob, default, and breach direction.
+
+    ``sense`` is the direction the threshold bounds: ``"max"`` means
+    the observed value must stay **at or below** it (breach when
+    ``value > threshold``); ``"min"`` means the value must stay **at or
+    above** it (breach when ``value < threshold``). ``alert`` marks the
+    rules the live engine evaluates mid-run; ``observable`` documents
+    the number fed to :func:`breached` so the two graders agree on
+    units, not just on the constant.
+    """
+
+    name: str
+    env: str
+    default: float
+    sense: str              # "max" | "min"
+    alert: bool
+    observable: str
+    description: str
+
+
+THRESHOLDS: Tuple[Threshold, ...] = (
+    Threshold(
+        name="straggler", env="TPUDIST_STRAGGLER_FACTOR",
+        default=STRAGGLER_FACTOR, sense="max", alert=True,
+        observable="worst host mean step time / pod median",
+        description="a host slower than the pod median by this factor "
+                    "drags every collective to its pace"),
+    Threshold(
+        name="staging", env="TPUDIST_STAGING_OVERLAP_MIN",
+        default=STAGING_OVERLAP_MIN, sense="min", alert=True,
+        observable="fraction of steady-state wall NOT exposed to "
+                   "staging waits",
+        description="below this, host->device transfer is not hiding "
+                    "behind compute and the pod is input-bound"),
+    Threshold(
+        name="comm", env="TPUDIST_COMM_EXPOSED_MAX",
+        default=COMM_EXPOSED_MAX, sense="max", alert=True,
+        observable="exposed-communication fraction of the device "
+                   "window",
+        description="communication the schedule failed to overlap "
+                    "with compute"),
+    Threshold(
+        name="regress", env="TPUDIST_REGRESS_MIN",
+        default=REGRESS_MIN_FRACTION, sense="min", alert=True,
+        observable="measured steps/s / baseline steps/s",
+        description="throughput below this fraction of baseline is a "
+                    "regression"),
+    Threshold(
+        name="stall", env="TPUDIST_STALL_TIMEOUT_S",
+        default=STALL_TIMEOUT_S, sense="max", alert=True,
+        observable="seconds since the last step-progress signal",
+        description="no step progress for this long means a wedged "
+                    "host (the watchdog dumps, the alert fires)"),
+    Threshold(
+        name="trace_drop", env="TPUDIST_TRACE_DROP_MAX",
+        default=TRACE_DROP_MAX, sense="max", alert=False,
+        observable="fraction of recorded spans the ring overwrote",
+        description="a trace with more holes than this under-counts "
+                    "exactly the longest runs"),
+)
+
+ALERT_RULES: Tuple[Threshold, ...] = tuple(
+    t for t in THRESHOLDS if t.alert)
+
+_BY_NAME = {t.name: t for t in THRESHOLDS}
+
+
+def get(name: str) -> Threshold:
+    """The rule named ``name``; KeyError on unknown names (a typo'd
+    rule must fail loudly, not grade vacuously)."""
+    return _BY_NAME[name]
+
+
+def resolve(name: str) -> float:
+    """The effective threshold: env override (read NOW) else default."""
+    rule = get(name)
+    raw = os.environ.get(rule.env)
+    if raw:
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+    return rule.default
+
+
+def breached(name: str, value: Optional[float],
+             threshold: Optional[float] = None) -> bool:
+    """Whether ``value`` breaches the rule. ``None`` never breaches
+    (no measurement = ungateable, the three-valued-verdict convention —
+    an alert must mean an observed bad number, not a missing one)."""
+    if value is None:
+        return False
+    if threshold is None:
+        threshold = resolve(name)
+    if get(name).sense == "max":
+        return value > threshold
+    return value < threshold

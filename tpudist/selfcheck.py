@@ -582,6 +582,80 @@ def check_flight_recorder():
           f"({len(art['thread_stacks'])} B of stacks)", flush=True)
 
 
+def check_live():
+    """The live-telemetry stall path end-to-end over REAL sockets: a
+    worker whose step loop wedges must get its ``stall`` alert onto the
+    Prometheus exporter and into ``live_status.json`` BEFORE any
+    launcher kill — the single-host stand-in for the pod stall story
+    (emitter → TCP ingest → aggregator → alert engine → /metrics, the
+    same path a pod exercises). Writes into $TPUDIST_OBS_DIR when set
+    (CI uploads the artifacts), else a temp dir."""
+    import json
+    import os
+    import tempfile
+    import time as _t
+    import urllib.request
+
+    from tpudist.metrics import MetricsLogger
+    from tpudist.obs import FlightRecorder
+    from tpudist.obs import live as live_mod
+
+    out_dir = os.environ.get("TPUDIST_OBS_DIR") or tempfile.mkdtemp(
+        prefix="tpudist_live_")
+    stall_s = 0.4
+    live = live_mod.LiveRun.start(
+        is_coordinator=True, process_index=0, out_dir=out_dir,
+        run_id="live-drill", stall_timeout_s=stall_s)
+    metrics = MetricsLogger(path=os.path.join(out_dir, "metrics.jsonl"))
+    metrics.emitter = live.emitter
+    rec = FlightRecorder(
+        out_dir, stall_timeout_s=stall_s, process_index=0,
+        metrics=metrics, emitter=live.emitter,
+        extra_state=lambda: {"live_status": live.snapshot_fields()})
+    try:
+        for step in range(3):            # healthy steps: beacons flow
+            rec.note_progress(phase="train", epoch=0, step=step)
+            metrics.log(kind="step", step=step, loss=1.0 / (step + 1))
+            _t.sleep(0.05)
+
+        deadline = _t.monotonic() + 30 * stall_s   # the wedge
+        while rec.dumps == 0 and _t.monotonic() < deadline:
+            _t.sleep(0.05)
+        assert rec.dumps >= 1, "watchdog never fired on the wedged step"
+
+        # the firing alert must reach the EXPORTER while the process is
+        # still alive (i.e. before any launcher kill) — bounded wait for
+        # the emitter→TCP→aggregator hop, then a real HTTP scrape
+        deadline = _t.monotonic() + 5.0
+        while _t.monotonic() < deadline:
+            if any(a["alert"] == "stall"
+                   for a in live.aggregator.engine.firing()):
+                break
+            _t.sleep(0.05)
+        url = f"http://127.0.0.1:{live.exporter.port}/metrics"
+        with urllib.request.urlopen(url, timeout=5) as r:
+            text = r.read().decode()
+        assert 'tpudist_alert_firing{alert="stall"} 1' in text, \
+            "stall alert not scrapeable at /metrics"
+        with open(os.path.join(out_dir, "live_status.json")) as f:
+            status = json.load(f)
+        assert status["status"] == "alert", status["status"]
+        assert any(a["alert"] == "stall"
+                   for a in status["alerts"]["firing"]), status["alerts"]
+    finally:
+        rec.close()
+        live.close()
+        metrics.close()
+
+    with open(rec.flightrec_path) as f:
+        art = json.load(f)
+    assert "live_status" in (art.get("extra") or {}), \
+        "pre-kill flight record missing the aggregator's live snapshot"
+    print(f"  live drill: stall alert scrapeable at :{live.exporter.port}"
+          f"/metrics before the kill; {out_dir}/live_status.json = "
+          f"{status['status']}", flush=True)
+
+
 def check_train_step_smoke():
     """One bf16 train step of the tiny transformer: finite, decreasing."""
     _train_smoke(dict(name="transformer", vocab_size=512, n_layers=2,
@@ -609,6 +683,7 @@ CHECKS = [
     check_ring_flash_merge,
     check_staging_stream,
     check_flight_recorder,
+    check_live,
     check_train_step_smoke,
     check_moe_smoke,
 ]

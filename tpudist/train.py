@@ -37,6 +37,7 @@ from tpudist.config import TrainConfig, parse_args
 from tpudist.metrics import (MetricsLogger, StagingStats, StepTimer,
                              device_kind, log0)
 from tpudist.obs import devtime as devtime_lib
+from tpudist.obs import live as live_lib
 from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
 
@@ -154,6 +155,39 @@ def run(cfg: TrainConfig) -> float:
         path=os.path.join(cfg.save_dir, "metrics.jsonl")
         if ctx.is_coordinator else None)
 
+    # run identity FIRST: the coordinator-broadcast run_id + the
+    # launcher's requeue attempt stamp every artifact this run writes —
+    # metrics records (MetricsLogger.extra), trace exports
+    # (Tracer.run_info), flight records / beacons (note_progress below),
+    # checkpoint meta — so the requeue loop's attempts stay correlatable
+    # across the artifact set (obs.live.resolve_run_id)
+    requeue_attempt = config_lib.resolve_requeue_attempt(cfg)
+    run_id = live_lib.resolve_run_id(ctx.process_count)
+    metrics.extra = {"run_id": run_id, "requeue_attempt": requeue_attempt}
+    tracer.run_info = {"run_id": run_id,
+                       "requeue_attempt": requeue_attempt}
+
+    # live telemetry bus (obs.live, --live on): the coordinator runs the
+    # aggregator + on-line alert engine + Prometheus exporter; EVERY
+    # process (coordinator included — same socket path as a pod) gets a
+    # non-blocking emitter that MetricsLogger and the heartbeat beacon
+    # fan records into. --live off constructs none of this.
+    live_enabled, live_port, live_endpoint = config_lib.resolve_live(cfg)
+    live = None
+    if live_enabled:
+        _stall_s, _obs_dir, _ = config_lib.resolve_obs(cfg)
+        live = live_lib.LiveRun.start(
+            is_coordinator=ctx.is_coordinator,
+            process_index=ctx.process_index, out_dir=_obs_dir,
+            run_id=run_id, requeue_attempt=requeue_attempt,
+            port=live_port, endpoint=live_endpoint,
+            stall_timeout_s=_stall_s, metrics=metrics)
+        metrics.emitter = live.emitter
+        if live.exporter is not None:
+            log0(f"tpudist: live on: ingest {live.endpoint}, Prometheus "
+                 f"/metrics on :{live.exporter.port}, live_status.json "
+                 f"in {_obs_dir}")
+
     # measured-probe autotune (tpudist.tune): replace the static
     # resolve_* guesses below with short on-device trials of the real
     # superstep (or a cached prior measurement) BEFORE the timed run —
@@ -223,7 +257,6 @@ def run(cfg: TrainConfig) -> float:
     # loss-correct.
     start_epoch, start_step_in_epoch = 0, 0
     resume_mode = config_lib.resolve_resume(cfg)
-    requeue_attempt = config_lib.resolve_requeue_attempt(cfg)
     resume_verdict = verdict_lib.UNGATEABLE
     if resume_mode:
         from tpudist.elastic import resume as elastic_resume
@@ -298,7 +331,16 @@ def run(cfg: TrainConfig) -> float:
     observer = obs_lib.PodObserver.from_config(
         cfg, metrics=metrics, process_index=ctx.process_index,
         process_count=ctx.process_count,
-        stall_hook=(win.emergency_stop if win is not None else None))
+        stall_hook=(win.emergency_stop if win is not None else None),
+        live=live,
+        # the beacon's live slice: cheap counter reads of the SAME
+        # observables the exit verdict grades (the aggregator turns
+        # run_s/wait_s into the live staging-overlap alert)
+        live_fields=lambda: {"run_s": timer.elapsed,
+                             "staging_streamed": staging.streamed,
+                             "staging_wait_s": staging.wait_s})
+    # the beacon/flight-record correlation keys ride the progress dict
+    observer.note_progress(run_id=run_id, requeue_attempt=requeue_attempt)
 
     # one manager for the whole run: async saves overlap the next epoch's
     # steps (the old save-per-call shape implied a synchronous drain).
@@ -313,10 +355,17 @@ def run(cfg: TrainConfig) -> float:
                 process_count=ctx.process_count,
                 use_async=not cfg.ckpt_sync,
                 run_meta={"seed": cfg.seed, "batch_size": cfg.batch_size,
-                          "model": cfg.model.name})
+                          "model": cfg.model.name,
+                          # correlation keys only — resume validates
+                          # just the data-cursor keys above, so a
+                          # different attempt still restores
+                          "run_id": run_id,
+                          "requeue_attempt": requeue_attempt})
         else:
-            ckpt = ckpt_lib.Checkpointer(cfg.save_dir,
-                                         use_async=not cfg.ckpt_sync)
+            ckpt = ckpt_lib.Checkpointer(
+                cfg.save_dir, use_async=not cfg.ckpt_sync,
+                run_meta={"run_id": run_id,
+                          "requeue_attempt": requeue_attempt})
 
     import contextlib
     # EVERY worker captures the profiler trace, into per-process
@@ -364,6 +413,12 @@ def run(cfg: TrainConfig) -> float:
             except Exception:
                 pass
         metrics.close()  # flush the buffered JSONL stream even on failure
+        if live is not None and not run_ok:
+            # a DYING run still publishes: bounded emitter drain, final
+            # live_status.json write, sockets down. The success path
+            # closes at the very end instead, so the run-end kind=timing
+            # record below still reaches the bus.
+            live.close()
 
     log0(f"throughput: {timer.steps_per_sec():.2f} steps/s "
          f"({timer.steps_per_sec_per_chip():.2f} steps/s/chip) on "
@@ -479,6 +534,17 @@ def run(cfg: TrainConfig) -> float:
                 trace_spans=(trace_summary or {}).get("spans"),
                 trace_dropped=(trace_summary or {}).get("dropped"),
                 **obs_fields)
+    if live is not None:
+        # after the timing record above so it reaches the bus; close()
+        # drains the emitter, waits (bounded) for in-flight frames, and
+        # writes the FINAL live_status.json — CI asserts its status
+        live.close()
+        if live.aggregator is not None:
+            snap = live.aggregator.snapshot()
+            n_alerts = (snap.get("alerts") or {}).get("events", 0)
+            log0(f"tpudist: live {snap.get('status', 'ok')}: "
+                 f"{live.aggregator.records} record(s), {n_alerts} alert "
+                 f"event(s) -> {live.aggregator.status_path}")
     log0("Training completed.")  # parity banner (train.py:128)
     metrics.close()
     return last_avg

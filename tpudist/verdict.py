@@ -18,6 +18,8 @@ from __future__ import annotations
 import os
 import subprocess
 
+from tpudist import rules as rules_lib
+
 # NO top-level jax import: this module sits on the jax-free offline
 # path (obs.report ← obs.__init__ ← obs.hoststats ← here), which must
 # run on a laptop with nothing but the stdlib + numpy installed. The
@@ -32,6 +34,12 @@ FAIL = "fail"
 # must not read as a bandwidth regression.
 UNGATEABLE = "ungateable"
 
+# The gate thresholds live in tpudist.rules — ONE table shared with the
+# live alert engine (tpudist.obs.alerts), so on-line and at-exit
+# grading cannot drift (tests/test_live.py diffs the two consumers).
+# The module-level names stay as aliases: they are this module's
+# documented surface.
+
 # Minimum steady-state staging overlap fraction (metrics.StagingStats)
 # before a streamed run is FLAGGED: below this, host→device transfer is
 # not hiding behind compute and the pod is silently input-bound.
@@ -39,23 +47,13 @@ UNGATEABLE = "ungateable"
 # staging is a perf finding, not a correctness failure. The env override
 # TPUDIST_STAGING_OVERLAP_MIN is read at CALL time, not import time, so
 # per-run overrides (and tests) take effect without a module reload.
-STAGING_OVERLAP_MIN = 0.5
+STAGING_OVERLAP_MIN = rules_lib.STAGING_OVERLAP_MIN
 
 # A host whose steady-state step time exceeds the pod median by this
 # factor is a straggler: every collective runs at its pace, so the whole
 # job's steps/s silently becomes that host's steps/s. Advisory, like the
 # staging gate; env override TPUDIST_STRAGGLER_FACTOR (call time).
-STRAGGLER_FACTOR = 1.25
-
-
-def _env_float(name: str, default: float) -> float:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        return default
+STRAGGLER_FACTOR = rules_lib.STRAGGLER_FACTOR
 
 
 def staging_status(streamed: bool, overlap_fraction,
@@ -67,8 +65,7 @@ def staging_status(streamed: bool, overlap_fraction,
     default :data:`STAGING_OVERLAP_MIN`) — so a pod run failing to hide
     H2D is flagged in the artifact stream, not silently slow."""
     if min_overlap is None:
-        min_overlap = _env_float("TPUDIST_STAGING_OVERLAP_MIN",
-                                 STAGING_OVERLAP_MIN)
+        min_overlap = rules_lib.resolve("staging")
     if not streamed or overlap_fraction is None:
         return UNGATEABLE
     return SUCCESS if overlap_fraction >= min_overlap else FAIL
@@ -83,7 +80,7 @@ def straggler_status(step_s_means, factor: float | None = None) -> str:
     ($TPUDIST_STRAGGLER_FACTOR, default :data:`STRAGGLER_FACTOR`)."""
     import statistics
     if factor is None:
-        factor = _env_float("TPUDIST_STRAGGLER_FACTOR", STRAGGLER_FACTOR)
+        factor = rules_lib.resolve("straggler")
     valid = [float(s) for s in step_s_means if s and s > 0]
     if len(valid) < 2:
         return UNGATEABLE
@@ -126,7 +123,7 @@ def tuning_status(mode: str, *, source: str = "heuristic",
 # recorded spans has a timeline with holes — flagged, because the run
 # report's phase totals silently under-count exactly the longest runs.
 # Env override TPUDIST_TRACE_DROP_MAX (call time, like the other gates).
-TRACE_DROP_MAX = 0.5
+TRACE_DROP_MAX = rules_lib.TRACE_DROP_MAX
 
 
 def trace_status(enabled: bool, spans: int, dropped: int,
@@ -143,7 +140,7 @@ def trace_status(enabled: bool, spans: int, dropped: int,
     if not enabled:
         return UNGATEABLE
     if drop_max is None:
-        drop_max = _env_float("TPUDIST_TRACE_DROP_MAX", TRACE_DROP_MAX)
+        drop_max = rules_lib.resolve("trace_drop")
     if not exported or spans <= 0:
         return FAIL
     total = spans + dropped
