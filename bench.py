@@ -548,6 +548,97 @@ def run_ckpt_sweep(out_path: str, n_steps: int = 64, repeats: int = 4,
     return art
 
 
+# -------------------------------------------------------------- serve sweep
+
+
+def run_serve_sweep(out_path: str, requests: int = 32,
+                    max_new: int = 16, rate: float = 200.0) -> dict:
+    """The serving row: decode-throughput curve over the decode_k ladder
+    × KV layouts on the serve probe harness (tpudist.serve.tune — full
+    occupancy, compiled superstep, same measurement the serve autotuner
+    trusts), then ONE real continuous-batching run at the sweep's best
+    point for the latency numbers only the request clock can produce:
+    p50/p99 TTFT, inter-token latency, tokens/s/chip, and the SLO
+    verdict. BENCH_SERVE.json on the shared artifact shape."""
+    from tpudist.parallel import build_mesh
+    from tpudist.serve import scheduler as sched
+    from tpudist.serve import slo as slo_lib
+    from tpudist.serve import tune as serve_tune
+    from tpudist.serve.engine import ServeEngine, init_params
+
+    model_cfg = ModelConfig(name="transformer", vocab_size=256,
+                            n_layers=2, d_model=64, n_heads=4,
+                            n_kv_heads=2, d_ff=128, max_seq_len=64)
+    slots, max_seq, prompt_pad = 4, 64, 16
+    mesh = build_mesh(ParallelConfig())
+    params = init_params(model_cfg, mesh, seed=0)
+
+    rows = []
+    for layout in ("st", "hs"):
+        for k in (1, 8, 32):
+            res = serve_tune.probe_candidate(
+                model_cfg, mesh, params,
+                serve_tune.ServeCandidate(decode_k=k, layout=layout),
+                slots=slots, max_seq=max_seq, prompt_pad=prompt_pad)
+            rows.append({"decode_k": k, "layout": layout,
+                         "feasible": res.feasible,
+                         "tokens_per_sec": round(res.tokens_per_sec, 2),
+                         # inf dispatch_ms (pruned point) must not leak
+                         # a bare `Infinity` token into the JSON
+                         "dispatch_ms": (round(res.dispatch_ms, 4)
+                                         if res.feasible else None),
+                         "spread": round(res.spread, 4),
+                         **({"error": res.error} if res.error else {})})
+            print(json.dumps(rows[-1]))
+    feasible = [r for r in rows if r["feasible"]]
+    if not feasible:
+        raise SystemExit(
+            "serve sweep: every (decode_k, layout) point was infeasible "
+            "on this device — see the per-point errors above; no "
+            "BENCH_SERVE.json written")
+    best = max(feasible, key=lambda r: r["tokens_per_sec"])
+
+    engine = ServeEngine(model_cfg, mesh, slots=slots, max_seq=max_seq,
+                         prompt_pad=prompt_pad,
+                         decode_k=best["decode_k"],
+                         layout=best["layout"])
+    engine.warmup(params)
+    reqs = sched.make_requests(requests, prompt_pad=prompt_pad,
+                               vocab_size=model_cfg.vocab_size,
+                               max_new=max_new, rate=rate, seed=0)
+    summary = sched.run_serve(engine, params, reqs)
+    engine.assert_two_programs()
+
+    art = {
+        "metric": "serve_tokens_per_sec_per_chip",
+        "value": summary["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip (continuous batching, greedy decode)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "model": "transformer", "slots": slots,
+            "max_seq": max_seq, "prompt_pad": prompt_pad,
+            "request_rate": rate,
+            "sweep_rows": rows,
+            "selected": {"decode_k": best["decode_k"],
+                         "layout": best["layout"]},
+            **{k: summary.get(k) for k in (
+                "requests", "completed", "generated_tokens",
+                "truncated", "wall_s", "dispatches", "tokens_per_sec",
+                "queue_depth_max", "queue_depth_mean", "ttft_p50_s",
+                "ttft_p99_s", "itl_p50_s", "itl_p99_s", "e2e_p50_s",
+                "e2e_p99_s", "prefill_compiles", "decode_compiles")},
+            "kv_cache_bytes": engine.spec.bytes,
+        },
+        "slo": slo_lib.slo_block(summary),
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({k: art[k] for k in ("metric", "value", "unit")}
+                     | {"slo": art["slo"]["status"]}))
+    return art
+
+
 # --------------------------------------------------------- collective sweep
 
 
@@ -740,6 +831,14 @@ def main() -> None:
                         "write BENCH_CKPT.json")
     p.add_argument("--ckpt-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_CKPT.json"))
+    p.add_argument("--serve-sweep", action="store_true",
+                   help="bench the serving engine: decode_k × KV-layout "
+                        "throughput curve on the serve probe harness + "
+                        "one continuous-batching run at the best point "
+                        "(TTFT/ITL percentiles, SLO verdict); write "
+                        "BENCH_SERVE.json")
+    p.add_argument("--serve-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE.json"))
     p.add_argument("--collective-sweep", action="store_true",
                    help="sweep the collectives over the mesh's data "
                         "axis (ICI/DCN-labeled) and write "
@@ -779,6 +878,9 @@ def main() -> None:
         return
     if args.ckpt_sweep:
         run_ckpt_sweep(args.ckpt_out)
+        return
+    if args.serve_sweep:
+        run_serve_sweep(args.serve_out)
         return
     if args.collective_sweep:
         run_collective_sweep(args.collective_out, args.collective_kinds,

@@ -23,6 +23,19 @@
 #   ACCELERATOR_TYPE    e.g. v5p-16 (expected chip count derives from this)
 #   GCS_VERDICT         gs:// URI for the machine-readable verdict
 # Optional:
+#   MODE                workload lane: train (default) or serve. serve
+#                       runs the batched inference engine
+#                       (python -m tpudist.serve: continuous batching,
+#                       sharded KV cache, latency-SLO verdict) instead
+#                       of the training job; on success the launcher
+#                       pulls BENCH_SERVE.json plus the serve run's
+#                       metrics-derived report (the serving section of
+#                       python -m tpudist.obs.report). Extra flags are
+#                       passed to the serve CLI (--requests,
+#                       --request-rate, --serve-tune probe, ...).
+#                       Requeue (MAX_REQUEUES) stays a train-lane
+#                       feature: a serve run has no checkpoint to
+#                       resume, so a failed serve run just stops.
 #   RUNTIME_VERSION     TPU software version (default v2-alpha-tpuv5)
 #   IMAGE               docker image to run (default: install this repo's
 #                       package on each worker and run bare python)
@@ -87,12 +100,19 @@ set -euo pipefail
 : "${ACCELERATOR_TYPE:?set ACCELERATOR_TYPE}"
 : "${GCS_VERDICT:?set GCS_VERDICT}"
 RUNTIME_VERSION="${RUNTIME_VERSION:-v2-alpha-tpuv5}"
+MODE="${MODE:-train}"
+case "$MODE" in train|serve) ;; *)
+  echo "MODE must be train or serve, got '$MODE'" >&2; exit 1 ;;
+esac
 TIMEOUT_S="${TIMEOUT_S:-1800}"
 OBS_DIR="${OBS_DIR:-/tmp/tpudist_obs}"
 POLL_S="${POLL_S:-10}"   # provisioning poll interval (tests shrink it)
 SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
 MAX_REQUEUES="${MAX_REQUEUES:-0}"
+# requeue stays a train-lane feature: a serve run has no checkpoint to
+# resume from, so a failed serve run stops instead of looping
+[ "$MODE" = "serve" ] && MAX_REQUEUES=0
 REQUEUE_BACKOFF_S="${REQUEUE_BACKOFF_S:-10}"
 # ONE run id for the whole launch, every attempt included: the workload
 # stamps it into every artifact (tpudist.obs.live.resolve_run_id
@@ -367,8 +387,17 @@ while :; do
   # pre-elastic contract (every launch trains from scratch) holds
   # unless the operator opted into elasticity
   RESUME_FLAGS=""
-  if [ "$MAX_REQUEUES" -gt 0 ]; then
+  if [ "$MODE" = "train" ] && [ "$MAX_REQUEUES" -gt 0 ]; then
     RESUME_FLAGS=" --resume auto --requeue-attempt $attempt"
+  fi
+  if [ "$MODE" = "serve" ]; then
+    # the serving acceptance lane: artifacts land in OBS_DIR so the
+    # one collection path below covers them (metrics + trace + bench)
+    WORKLOAD="python3 -m tpudist.serve --save-dir $OBS_DIR/serve \
+    --bench-out $OBS_DIR/BENCH_SERVE.json --trace-dir $OBS_DIR"
+  else
+    WORKLOAD="python3 -m tpudist.train \
+    --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$RESUME_FLAGS"
   fi
   # TPUDIST_VERDICT_PATH into OBS_DIR: every worker's orderly death
   # writes job_status.txt.worker<i> next to its heartbeat beacon, and
@@ -381,8 +410,7 @@ while :; do
   # reaches every worker's environment.
   set +e
   tpu_ssh all "TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt $LIVE_ENV \
-    timeout -k 60 $TIMEOUT_S $RUN_PREFIX python3 -m tpudist.train \
-    --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$RESUME_FLAGS$EXTRA_Q"
+    timeout -k 60 $TIMEOUT_S $RUN_PREFIX $WORKLOAD$EXTRA_Q"
   RC=$?
   set -e
   [ $RC -eq 0 ] && break
@@ -439,8 +467,17 @@ echo -n success | gsutil cp - "$GCS_VERDICT"
 # under the workload's --save-dir (default ckpt/ in the ssh home dir);
 # an operator overriding --save-dir also gets the report via the scp'd
 # pod_trace.json and a local re-run of the report CLI.
+# MODE=serve keeps its metrics under $OBS_DIR/serve and adds the
+# BENCH_SERVE.json artifact (SLO percentiles + verdict) to the pull —
+# the report CLI's schema-4 "Serving" section folds the same records.
+METRICS_PATH="ckpt/metrics.jsonl"
+SERVE_PULL=""
+if [ "$MODE" = "serve" ]; then
+  METRICS_PATH="$OBS_DIR/serve/metrics.jsonl"
+  SERVE_PULL="$TPU_NAME:$OBS_DIR/BENCH_SERVE.json"
+fi
 tpu_ssh 0 "$RUN_PREFIX python3 -m tpudist.obs.report --run-dir $OBS_DIR \
-  --metrics ckpt/metrics.jsonl \
+  --metrics $METRICS_PATH \
   --out-json $OBS_DIR/run_report.json \
   --out-md $OBS_DIR/run_report.md" || true
 mkdir -p flightrec_artifacts
@@ -448,6 +485,7 @@ gcloud compute tpus tpu-vm scp \
   "$TPU_NAME:$OBS_DIR/pod_trace.json" \
   "$TPU_NAME:$OBS_DIR/run_report.json" \
   "$TPU_NAME:$OBS_DIR/run_report.md" \
+  $SERVE_PULL \
   flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
   --worker=0 2>/dev/null || true
 # --profile-window device captures (raw jax.profiler trace-event JSON

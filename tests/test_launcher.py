@@ -60,6 +60,8 @@ case "$*" in
         exit 0
       fi
       exit "${STUB_TRAIN_RC:-0}"
+    elif [[ "$*" == *"-m tpudist.serve"* ]]; then
+      exit "${STUB_SERVE_RC:-0}"
     elif [[ "$*" == *"tpudist.bench.sweep"* ]]; then
       exit "${STUB_SWEEP_RC:-0}"
     fi
@@ -456,3 +458,55 @@ def test_live_off_by_default_but_run_id_always_stamped(stub_env):
     assert "TPUDIST_LIVE=on" not in train
     calls = (stub / "calls.log").read_text()
     assert "live_status.json" not in calls
+
+
+def _serve_lines(stub):
+    return [ln for ln in (stub / "calls.log").read_text().splitlines()
+            if "-m tpudist.serve" in ln]
+
+
+def test_serve_mode_runs_serve_workload_and_pulls_bench(stub_env):
+    """MODE=serve swaps the workload for the serving acceptance lane
+    (python -m tpudist.serve under the same timeout/verdict plumbing)
+    and on success pulls BENCH_SERVE.json alongside the trace/report,
+    with the report pointed at the serve run's metrics.jsonl."""
+    env, stub = stub_env
+    env["MODE"] = "serve"
+    r = launch(env, "--requests", "8")
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert verdict(stub) == "success"
+    serves = _serve_lines(stub)
+    assert len(serves) == 1, serves
+    assert not _train_lines(stub), "serve mode must not run training"
+    sv = serves[0]
+    assert "timeout -k 60 30" in sv                   # bounded like train
+    assert "--bench-out /tmp/tpudist_obs/BENCH_SERVE.json" in sv
+    assert "--save-dir /tmp/tpudist_obs/serve" in sv
+    assert "--requests 8" in sv                       # extra flags ride
+    calls = (stub / "calls.log").read_text().splitlines()
+    pulls = [ln for ln in calls if "scp" in ln and "BENCH_SERVE.json" in ln]
+    assert pulls and "--worker=0" in pulls[0], calls
+    reports = [ln for ln in calls if "tpudist.obs.report" in ln]
+    assert reports and \
+        "--metrics /tmp/tpudist_obs/serve/metrics.jsonl" in reports[0]
+
+
+def test_serve_mode_failure_is_never_requeued(stub_env):
+    """A failed serve run stops even with a requeue budget and a
+    preemption-shaped exit code: there is no checkpoint to resume, so
+    requeue stays a train-lane feature."""
+    env, stub = stub_env
+    env.update(MODE="serve", MAX_REQUEUES="3", REQUEUE_BACKOFF_S="0",
+               STUB_SERVE_RC="137")
+    r = launch(env)
+    assert r.returncode == 1
+    assert verdict(stub) == "fail"
+    assert len(_serve_lines(stub)) == 1
+
+
+def test_bad_mode_rejected(stub_env):
+    env, stub = stub_env
+    env["MODE"] = "infer"
+    r = launch(env)
+    assert r.returncode == 1
+    assert "MODE must be train or serve" in r.stderr

@@ -57,7 +57,7 @@ def make_agg(tmp_path, **kw):
 def test_rules_table_names_and_alert_subset():
     names = {t.name for t in rules_lib.THRESHOLDS}
     assert names == {"straggler", "staging", "comm", "regress", "stall",
-                     "trace_drop"}
+                     "trace_drop", "ttft", "itl", "tokens_per_chip"}
     # every rule but the artifact-quality one is a live alert
     assert {t.name for t in rules_lib.ALERT_RULES} == names - {
         "trace_drop"}
@@ -461,6 +461,11 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     agg.ingest({"kind": "epoch", "steps_per_sec": sps}, now=clk.t)
     agg.ingest({"kind": "stall_dump", "process_index": 0,
                 "stall_s": stall}, now=clk.t)
+    # a serving run whose exit verdict would grade every SLO gate fail
+    ttft, itl, tps_chip = 99.0, 99.0, 0.01
+    agg.ingest({"kind": "serve_tick", "ttft_p99_s": ttft,
+                "itl_p99_s": itl, "tokens_per_sec_per_chip": tps_chip},
+               now=clk.t)
     fired = {a["alert"] for a in agg.engine.firing()}
     assert fired == {t.name for t in rules_lib.ALERT_RULES}, fired
 
@@ -474,6 +479,8 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
         {"steps_per_sec": 10.0},
         rules_lib.resolve("regress"))["status"] == report_lib.FAIL
     assert stall > 5.0               # the watchdog's own dump condition
+    assert verdict_lib.serve_status(ttft, itl, tps_chip) \
+        == verdict_lib.FAIL
     agg.close()
 
 
@@ -520,6 +527,9 @@ tpudist_alert_firing{alert="staging"} 0
 tpudist_alert_firing{alert="comm"} 0
 tpudist_alert_firing{alert="regress"} 0
 tpudist_alert_firing{alert="stall"} 1
+tpudist_alert_firing{alert="ttft"} 0
+tpudist_alert_firing{alert="itl"} 0
+tpudist_alert_firing{alert="tokens_per_chip"} 0
 # HELP tpudist_alerts_total Alert fire/resolve transitions so far.
 # TYPE tpudist_alerts_total counter
 tpudist_alerts_total 1

@@ -179,9 +179,16 @@ def _attention(q, k, v, *, causal: bool = True, cos=None, sin=None):
 _attention.accepts_rope = True
 
 
-def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
+def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl,
+                   return_kv: bool = False):
     """Pre-norm attention + residual. Shared with the MoE model, whose
-    layers differ only in the FFN half."""
+    layers differ only in the FFN half.
+
+    ``return_kv=True`` is the serving PREFILL mode: the rotated compact
+    (GQA) k/v are returned alongside the output so the caller can seed a
+    per-sequence KV cache — rotation then always happens here (the
+    cached keys must carry their absolute-position rotation, which is
+    what lets decode append one rotated key at a time)."""
     b, s, d = x.shape
     h, kv = cfg.n_heads, cfg.n_kv_heads
     hd = d // h
@@ -193,7 +200,7 @@ def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     v = (y @ lp["wv"].astype(dt)).reshape(b, s, kv, hd)
     # GQA: compact kv heads go to the attention impl as-is — ring attention
     # must transfer the small blocks; expansion happens inside the kernel.
-    if getattr(attn_impl, "accepts_rope", False):
+    if getattr(attn_impl, "accepts_rope", False) and not return_kv:
         # rope-aware impls take the tables and rotate internally (the flash
         # kernel rotates blocks in VMEM — no rotated-tensor HBM round-trip)
         o = attn_impl(q, k, v, cos=cos, sin=sin)
@@ -202,28 +209,164 @@ def _attn_sublayer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
         k = apply_rope(k, cos, sin)
         o = attn_impl(q, k, v)
     o = o.reshape(b, s, h * hd)
-    return x + o @ lp["wo"].astype(dt)
+    out = x + o @ lp["wo"].astype(dt)
+    return (out, k, v) if return_kv else out
+
+
+def _ffn_sublayer(x, lp, cfg: ModelConfig):
+    """Pre-norm SwiGLU FFN + residual — shared by the training layer and
+    the serving (prefill/decode) layers so the FFN math cannot fork."""
+    dt = x.dtype
+    y = rmsnorm(x, lp["ffn_norm"])
+    gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
+    up = y @ lp["w_up"].astype(dt)
+    return x + (gate * up) @ lp["w_down"].astype(dt)
 
 
 def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     """One decoder layer. x: (batch, seq, d_model)."""
-    dt = x.dtype
     x = _attn_sublayer(x, lp, cfg, cos, sin, attn_impl)
-    y = rmsnorm(x, lp["ffn_norm"])
-    gate = jax.nn.silu(y @ lp["w_gate"].astype(dt))
-    up = y @ lp["w_up"].astype(dt)
-    x = x + (gate * up) @ lp["w_down"].astype(dt)
-    return x
+    return _ffn_sublayer(x, lp, cfg)
+
+
+def decode_rope(x: jax.Array, positions: jax.Array,
+                theta: float) -> jax.Array:
+    """Rotate one new token per slot at its absolute position.
+
+    x: (batch, 1, heads, head_dim); positions: (batch,) int32 — each
+    slot in a continuously-batched decode step sits at its OWN sequence
+    position, so the table-based :func:`apply_rope` (one shared position
+    per column) does not fit; the frequency derivation itself stays in
+    :func:`precompute_rope` (``positions=``) so there is ONE site for
+    any future theta/interpolation change. Same pair convention as
+    apply_rope: channel i rotates with channel i + head_dim/2."""
+    hd = x.shape[-1]
+    cos, sin = precompute_rope(0, hd, theta, positions=positions)
+    cos = cos[:, None, None, :].astype(x.dtype)
+    sin = sin[:, None, None, :].astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1)
+
+
+def _cached_attention(q, k_new, v_new, cache_k, cache_v, pos):
+    """One-token incremental attention against a per-slot KV cache.
+
+    q/k_new/v_new: (batch, 1, heads|kv, head_dim), ALREADY rotated at
+    ``pos``; cache_k/cache_v: (batch, max_seq, kv, head_dim) holding the
+    rotated keys/values of positions ``[0, pos)``; pos: (batch,) int32
+    per-slot write positions. The new k/v land at ``pos`` and attention
+    covers keys ``[0, pos]`` inclusive — positions beyond each slot's
+    own length are masked, so stale cache rows (a freed slot's tail, a
+    padded prompt's tail) can never leak into another sequence. Same
+    f32-softmax discipline as :func:`_attention`, which is what keeps
+    decode logits ULP-close to the full forward."""
+    from tpudist.ops.gqa import expand_gqa
+    b, t = cache_k.shape[0], cache_k.shape[1]
+    slot = jnp.arange(b)
+    cache_k = cache_k.at[slot, pos].set(k_new[:, 0].astype(cache_k.dtype))
+    cache_v = cache_v.at[slot, pos].set(v_new[:, 0].astype(cache_v.dtype))
+    k, v = expand_gqa(q, cache_k, cache_v)
+    hd = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+    mask = jnp.arange(t)[None, :] <= pos[:, None]            # (b, t)
+    scores = jnp.where(mask[:, None, None, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v), cache_k, cache_v
+
+
+def _attn_sublayer_cached(x, lp, cfg: ModelConfig, pos, cache_k, cache_v):
+    """The incremental (decode) twin of :func:`_attn_sublayer`: one new
+    token per slot, q/k/v projected and rotated at the slot's own
+    position, attention against the layer's KV cache. Returns
+    ``(out, cache_k', cache_v')``. Shared with the MoE model, whose
+    decode layers differ only in the FFN half."""
+    b, s, d = x.shape           # s == 1 (one appended token per slot)
+    h, kv = cfg.n_heads, cfg.n_kv_heads
+    hd = d // h
+    dt = x.dtype
+    y = rmsnorm(x, lp["attn_norm"])
+    q = (y @ lp["wq"].astype(dt)).reshape(b, s, h, hd)
+    k = (y @ lp["wk"].astype(dt)).reshape(b, s, kv, hd)
+    v = (y @ lp["wv"].astype(dt)).reshape(b, s, kv, hd)
+    q = decode_rope(q, pos, cfg.rope_theta)
+    k = decode_rope(k, pos, cfg.rope_theta)
+    o, cache_k, cache_v = _cached_attention(q, k, v, cache_k, cache_v,
+                                            pos)
+    o = o.reshape(b, s, h * hd)
+    return x + o @ lp["wo"].astype(dt), cache_k, cache_v
+
+
+def _cached_hidden_states(params: Params, tokens: jax.Array,
+                          cfg: ModelConfig, *, dtype, kv_cache,
+                          cur_index, ffn=_ffn_sublayer):
+    """Incremental forward against a per-sequence KV cache.
+
+    ``kv_cache`` is ``{"k", "v"}`` of shape (n_layers, batch, max_seq,
+    n_kv_heads, head_dim) — the canonical layout (tpudist.serve.kvcache
+    owns any alternative storage layouts and transposes around this).
+
+    * ``cur_index=None`` → PREFILL: full causal forward over ``tokens``
+      (batch, prompt_pad); each layer's rotated k/v are written into
+      cache positions ``[0, prompt_pad)``. Positions past a prompt's
+      true length hold pad-token junk, which the decode mask (keys
+      ``<= pos``) never reads.
+    * ``cur_index`` (batch,) int32 → DECODE: ``tokens`` (batch, 1), one
+      token appended per slot at its own position.
+
+    ``ffn(x, lp, cfg)`` is the per-layer FFN half (residual included) —
+    the ONE thing the MoE model swaps; the whole cache contract lives
+    here once. Returns ``(h, kv_cache')`` with ``h`` final-normed."""
+    ck, cv = kv_cache["k"], kv_cache["v"]
+    x = params["embed"].astype(dtype)[tokens]
+    unroll = cfg.n_layers <= 8
+    if cur_index is None:
+        s = tokens.shape[1]
+        hd = cfg.d_model // cfg.n_heads
+        cos, sin = precompute_rope(s, hd, cfg.rope_theta)
+
+        def body(x, lp):
+            x, k, v = _attn_sublayer(x, lp, cfg, cos, sin, _attention,
+                                     return_kv=True)
+            return ffn(x, lp, cfg), (k, v)
+
+        x, (ks, vs) = lax.scan(body, x, params["layers"], unroll=unroll)
+        # ks: (L, b, s, kv, hd) — seed cache columns [0, s)
+        ck = ck.at[:, :, :s].set(ks.astype(ck.dtype))
+        cv = cv.at[:, :, :s].set(vs.astype(cv.dtype))
+    else:
+        def body(x, xs):
+            lp, ck_l, cv_l = xs
+            x, ck_l, cv_l = _attn_sublayer_cached(x, lp, cfg, cur_index,
+                                                  ck_l, cv_l)
+            return ffn(x, lp, cfg), (ck_l, cv_l)
+
+        x, (ck, cv) = lax.scan(body, x, (params["layers"], ck, cv),
+                               unroll=unroll)
+    return rmsnorm(x, params["final_norm"]), {"k": ck, "v": cv}
 
 
 def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                   dtype=jnp.bfloat16, attn_impl=_attention,
                   rope_offset=0, rope_positions=None,
-                  remat: bool = False) -> jax.Array:
+                  remat: bool = False, kv_cache=None,
+                  cur_index=None) -> jax.Array:
     """Backbone forward: tokens (batch, seq) -> final-norm hidden states
     (batch, seq, d_model) in ``dtype``. ``remat`` checkpoints each layer
     (recompute activations in backward — HBM for FLOPs, the standard TPU
-    trade when memory, not compute, limits batch size)."""
+    trade when memory, not compute, limits batch size).
+
+    ``kv_cache``/``cur_index`` select the serving path
+    (:func:`_cached_hidden_states`): prefill seeds the cache, decode
+    appends one token per slot — return type becomes ``(h, kv_cache')``.
+    """
+    if kv_cache is not None:
+        return _cached_hidden_states(params, tokens, cfg, dtype=dtype,
+                                     kv_cache=kv_cache,
+                                     cur_index=cur_index)
     s = tokens.shape[1]
     hd = cfg.d_model // cfg.n_heads
     cos, sin = precompute_rope(s, hd, cfg.rope_theta, offset=rope_offset,
@@ -245,13 +388,21 @@ def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
 def apply(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
           dtype=jnp.bfloat16, attn_impl=_attention,
           rope_offset=0, rope_positions=None,
-          remat: bool = False) -> jax.Array:
+          remat: bool = False, kv_cache=None, cur_index=None) -> jax.Array:
     """Forward: tokens (batch, seq) int32 -> logits (batch, seq, vocab) f32.
 
     ``attn_impl`` lets context-parallel callers substitute ring attention;
     ``rope_offset`` / ``rope_positions`` give each context shard its
-    absolute positions.
+    absolute positions. With ``kv_cache`` the serving path runs instead
+    and the return is ``(logits, kv_cache')`` (see
+    :func:`_cached_hidden_states`).
     """
+    if kv_cache is not None:
+        x, kv_cache = hidden_states(params, tokens, cfg, dtype=dtype,
+                                    kv_cache=kv_cache,
+                                    cur_index=cur_index)
+        logits = (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+        return logits, kv_cache
     x = hidden_states(params, tokens, cfg, dtype=dtype, attn_impl=attn_impl,
                       rope_offset=rope_offset, rope_positions=rope_positions,
                       remat=remat)

@@ -423,6 +423,24 @@ class LiveAggregator:
                 k: rec.get(k) for k in ("status", "source",
                                         "resumed_from_step",
                                         "requeue_attempt")}
+        elif kind in ("serve_tick", "serve"):
+            # the serving loop's periodic SLO observations (and its
+            # final summary): latest values win the status doc, and the
+            # three serve gates ride the SAME alert engine the training
+            # rules do — an SLO breach fires mid-run, not at exit
+            sv = self._pod.setdefault("serve", {})
+            for k in ("queue_depth", "active_slots", "completed",
+                      "generated_tokens", "ttft_p99_s", "itl_p99_s",
+                      "tokens_per_sec_per_chip", "status"):
+                if rec.get(k) is not None:
+                    sv[k] = rec[k]
+            step = sv.get("completed")
+            self.engine.observe("ttft", rec.get("ttft_p99_s"),
+                                step=step)
+            self.engine.observe("itl", rec.get("itl_p99_s"), step=step)
+            self.engine.observe("tokens_per_chip",
+                                rec.get("tokens_per_sec_per_chip"),
+                                step=step)
         elif kind == "stall_dump":
             # the watchdog's last gasp: the worker MEASURED this many
             # seconds without step progress before dumping — observe it
@@ -720,6 +738,14 @@ _PROM_HELP = {
     "tpudist_host_progress_age_seconds": "Seconds since the host's "
                                          "step last advanced.",
     "tpudist_host_hbm_peak_bytes": "Per-host HBM high-water mark.",
+    "tpudist_serve_queue_depth": "Requests waiting for a slot.",
+    "tpudist_serve_active_slots": "Slots holding a live sequence.",
+    "tpudist_serve_completed_total": "Requests completed so far.",
+    "tpudist_serve_generated_tokens_total": "Tokens generated so far.",
+    "tpudist_serve_ttft_p99_seconds": "p99 time-to-first-token.",
+    "tpudist_serve_itl_p99_seconds": "p99 inter-token latency.",
+    "tpudist_serve_tokens_per_sec_per_chip": "Decode throughput per "
+                                             "chip.",
     "tpudist_alert_firing": "1 while the named alert rule fires.",
     "tpudist_alerts_total": "Alert fire/resolve transitions so far.",
     "tpudist_records_total": "Telemetry records ingested.",
@@ -792,6 +818,19 @@ def prometheus_text(status: Dict[str, Any]) -> str:
     metric("tpudist_host_hbm_peak_bytes",
            [({"host": pi}, h.get("hbm_peak_bytes"))
             for pi, h in hosts.items()])
+    sv = pod.get("serve") or {}
+    metric("tpudist_serve_queue_depth", [({}, sv.get("queue_depth"))])
+    metric("tpudist_serve_active_slots",
+           [({}, sv.get("active_slots"))])
+    metric("tpudist_serve_completed_total", [({}, sv.get("completed"))],
+           mtype="counter")
+    metric("tpudist_serve_generated_tokens_total",
+           [({}, sv.get("generated_tokens"))], mtype="counter")
+    metric("tpudist_serve_ttft_p99_seconds",
+           [({}, sv.get("ttft_p99_s"))])
+    metric("tpudist_serve_itl_p99_seconds", [({}, sv.get("itl_p99_s"))])
+    metric("tpudist_serve_tokens_per_sec_per_chip",
+           [({}, sv.get("tokens_per_sec_per_chip"))])
     # one series per alert RULE: 1 when any (rule, host) key fires —
     # a fixed label set scrapers can alert on without knowing hosts
     firing_rules = {a["alert"] for a in alerts.get("firing", [])}

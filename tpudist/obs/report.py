@@ -42,8 +42,11 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from tpudist import rules as rules_lib
 from tpudist.obs import devtime as devtime_mod
+from tpudist.serve import slo as slo_mod
 
-REPORT_SCHEMA_VERSION = 3
+# Schema 4: adds the "serving" section (latency percentiles, queue
+# depth over time, SLO verdict vs optional baseline — tpudist.serve).
+REPORT_SCHEMA_VERSION = 4
 
 SUCCESS = "success"
 FAIL = "fail"
@@ -544,6 +547,83 @@ def regression_section(timing: Optional[Dict],
             "ratio": round(ratio, 4), "min_fraction": min_fraction}
 
 
+def serving_section(metrics: List[Dict[str, Any]],
+                    baseline: Optional[Dict] = None) -> Dict[str, Any]:
+    """The serving slice of the report (tpudist.serve): the run's
+    latency percentiles and throughput RE-GRADED through the shared SLO
+    gates (tpudist.serve.slo over the rules table — same thresholds the
+    serve loop's on-line alerts and exit verdict applied, env read at
+    fold time), queue depth over time from the ``kind=serve_tick``
+    stream, and an optional throughput comparison against a baseline
+    BENCH_SERVE.json / prior report. Runs without serve records read as
+    ``enabled: False`` — a training run has no SLO to grade."""
+    serves = [r for r in metrics if r.get("kind") == "serve"]
+    if not serves:
+        return {"enabled": False}
+    s = serves[-1]
+    graded = slo_mod.grade(s.get("ttft_p99_s"), s.get("itl_p99_s"),
+                           s.get("tokens_per_sec_per_chip"))
+    ticks = [r for r in metrics if r.get("kind") == "serve_tick"]
+    queue = [{"t_s": r.get("t_s"), "queue_depth": r.get("queue_depth"),
+              "active_slots": r.get("active_slots"),
+              "completed": r.get("completed")} for r in ticks]
+    tunes = [r for r in metrics if r.get("kind") == "serve_tune"]
+    base_tps = _find_serve_tps(baseline) if baseline else None
+    tps = s.get("tokens_per_sec_per_chip")
+    ratio = (round(tps / base_tps, 4)
+             if isinstance(tps, (int, float)) and base_tps else None)
+    return {
+        "enabled": True,
+        "status": graded["status"],
+        "gates": {rule: graded[f"{rule}_status"]
+                  for rule, _ in slo_mod.SERVE_RULES},
+        "thresholds": {rule: rules_lib.resolve(rule)
+                       for rule, _ in slo_mod.SERVE_RULES},
+        "requests": s.get("requests"), "completed": s.get("completed"),
+        "generated_tokens": s.get("generated_tokens"),
+        "truncated": s.get("truncated"), "wall_s": s.get("wall_s"),
+        "slots": s.get("slots"), "decode_k": s.get("decode_k"),
+        "kv_layout": s.get("kv_layout"),
+        "kv_cache_bytes": s.get("kv_cache_bytes"),
+        "tokens_per_sec": s.get("tokens_per_sec"),
+        "tokens_per_sec_per_chip": tps,
+        "ttft_p50_s": s.get("ttft_p50_s"),
+        "ttft_p99_s": s.get("ttft_p99_s"),
+        "itl_p50_s": s.get("itl_p50_s"),
+        "itl_p99_s": s.get("itl_p99_s"),
+        "e2e_p99_s": s.get("e2e_p99_s"),
+        "prefill_compiles": s.get("prefill_compiles"),
+        "decode_compiles": s.get("decode_compiles"),
+        "queue_depth_max": s.get("queue_depth_max"),
+        "queue_over_time": queue,
+        "tuning": ({k: tunes[-1].get(k) for k in
+                    ("status", "source", "trials", "decode_k", "layout")}
+                   if tunes else None),
+        "baseline_tokens_per_sec_per_chip": base_tps,
+        "tokens_per_chip_ratio": ratio,
+    }
+
+
+def _find_serve_tps(doc: Any) -> Optional[float]:
+    """Dig a serve tokens/s/chip baseline out of a document: a
+    BENCH_SERVE.json (top-level ``value`` under the serve metric name),
+    a prior run_report's serving section, or a bare number under
+    ``tokens_per_sec_per_chip``."""
+    if not isinstance(doc, dict):
+        return None
+    if doc.get("metric") == "serve_tokens_per_sec_per_chip" \
+            and isinstance(doc.get("value"), (int, float)):
+        return float(doc["value"])
+    for path in (("tokens_per_sec_per_chip",),
+                 ("serving", "tokens_per_sec_per_chip")):
+        cur: Any = doc
+        for k in path:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, (int, float)) and cur > 0:
+            return float(cur)
+    return None
+
+
 def _find_steps_per_sec(doc: Any) -> Optional[float]:
     """Dig a steps/s number out of a baseline document: top-level
     ``steps_per_sec``, a run_report's ``regression.steps_per_sec``, or
@@ -593,6 +673,7 @@ def build_report(metrics: List[Dict[str, Any]],
     stragglers = straggler_section(hosts, metrics)
     devtime = devtime_section(all_events, metrics, baseline)
     alerts = alerts_section(metrics, alert_history, timing)
+    serving = serving_section(metrics, baseline)
     # the correlation id: every metrics record carries it (the train
     # CLI stamps MetricsLogger.extra); older artifacts fall back to the
     # trace metadata
@@ -604,10 +685,16 @@ def build_report(metrics: List[Dict[str, Any]],
         for c, s in h["phases"].items():
             pod_phases[c] = pod_phases.get(c, 0.0) + s
 
+    # a serving section whose gates all read ungateable measured
+    # NOTHING — it must not count as evidence toward a success verdict
+    # (the serve CLI's own exit verdict for that run is ungateable)
+    serving_measured = serving["enabled"] \
+        and serving["status"] != UNGATEABLE
     verdict = SUCCESS
-    if regression["status"] == FAIL or stragglers["status"] == FAIL:
+    if regression["status"] == FAIL or stragglers["status"] == FAIL \
+            or (serving["enabled"] and serving["status"] == FAIL):
         verdict = FAIL
-    elif not events:
+    elif not events and not serving_measured:
         verdict = UNGATEABLE
 
     return {
@@ -654,6 +741,7 @@ def build_report(metrics: List[Dict[str, Any]],
         "collectives": collectives_section(collectives),
         "stragglers": stragglers,
         "regression": regression,
+        "serving": serving,
         "alerts": alerts,
         "verdict": verdict,
     }
@@ -771,6 +859,33 @@ def to_markdown(report: Dict[str, Any]) -> str:
                 + (f"{pct:.1f}" if pct is not None else "—")
                 + f" | {k.get('message_bytes')} |")
         lines.append("")
+    sv = r.get("serving") or {}
+    if sv.get("enabled"):
+        lines += ["## Serving (latency SLOs)", "",
+                  f"**serve_status: {sv['status']}** — "
+                  + ", ".join(f"{rule} {st}"
+                              for rule, st in sv["gates"].items()), "",
+                  f"- {sv['completed']}/{sv['requests']} requests, "
+                  f"{sv['generated_tokens']} tokens in "
+                  f"{sv['wall_s']:.3f}s "
+                  f"({sv['tokens_per_sec_per_chip']} tok/s/chip"
+                  + (f", {sv['tokens_per_chip_ratio']}x baseline"
+                     if sv.get("tokens_per_chip_ratio") is not None
+                     else "") + ")",
+                  f"- TTFT p50/p99: {sv['ttft_p50_s']}/"
+                  f"{sv['ttft_p99_s']}s; ITL p50/p99: "
+                  f"{sv['itl_p50_s']}/{sv['itl_p99_s']}s",
+                  f"- {sv['slots']} slot(s), decode_k "
+                  f"{sv['decode_k']}, kv layout {sv['kv_layout']}, "
+                  f"queue depth max {sv['queue_depth_max']}, compiles "
+                  f"{sv['prefill_compiles']} prefill / "
+                  f"{sv['decode_compiles']} decode", ""]
+        if sv.get("tuning"):
+            t = sv["tuning"]
+            lines += [f"- serve tune: {t.get('status')} "
+                      f"({t.get('source')}, {t.get('trials')} trial(s)) "
+                      f"→ decode_k {t.get('decode_k')}, layout "
+                      f"{t.get('layout')}", ""]
     al = r.get("alerts") or {}
     if al.get("enabled"):
         lines += ["## Alerts (live telemetry)", ""]

@@ -155,6 +155,30 @@ def plan_slabs(n_steps: int, k: int, step_bytes: int,
                     budget_bytes, streamed=True)
 
 
+KV_CACHE_LAYOUTS = ("st", "hs")
+
+
+def kv_cache_specs(layout: str = "st") -> P:
+    """``param_specs``-style PartitionSpec for the serving KV cache
+    (tpudist.serve): one spec serves both the K and V arrays.
+
+    Canonical ``"st"`` layout: ``(layers, slots, seq, kv_heads,
+    head_dim)`` — the slot (per-sequence) dim rides the batch axes like
+    every activation (:func:`batch_spec`), kv heads ride the tensor axis
+    (the Megatron head split the attention weights already use), and
+    the layer/seq/head_dim dims stay unsharded. ``"hs"`` stores heads
+    ahead of the sequence dim (``(layers, slots, kv_heads, seq,
+    head_dim)``) — an alternative physical layout the serve autotuner
+    probes. Compose with :func:`sanitize_specs` so odd slot/head counts
+    fall back to replicated instead of erroring."""
+    if layout == "st":
+        return P(None, ("data", "fsdp"), None, "tensor", None)
+    if layout == "hs":
+        return P(None, ("data", "fsdp"), "tensor", None, None)
+    raise ValueError(f"unknown kv-cache layout {layout!r}: "
+                     f"{' | '.join(KV_CACHE_LAYOUTS)}")
+
+
 def norm_shard_index(idx, shape) -> tuple:
     """A sharding index (tuple of slices, as produced by
     ``Sharding.devices_indices_map`` / ``Shard.index``) normalised to

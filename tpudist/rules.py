@@ -41,6 +41,15 @@ TRACE_DROP_MAX = 0.5        # verdict.trace_status (no live alert: a
 #                             dropped-span ratio is an artifact-quality
 #                             finding, not a mid-run health signal)
 
+# Serving SLOs (tpudist.serve): latency is where a serving pod is won
+# or lost, so the gates are latency-percentile bounds plus a throughput
+# floor. The defaults are deliberately loose enough for the CI CPU-mesh
+# acceptance lane (a warmed tiny-model engine decodes in milliseconds);
+# production deployments tighten them per model via the env overrides.
+TTFT_P99_MAX = 2.0          # serve: p99 time-to-first-token (seconds)
+ITL_P99_MAX = 1.0           # serve: p99 inter-token latency (seconds)
+TOKENS_PER_CHIP_MIN = 1.0   # serve: decode throughput floor (tok/s/chip)
+
 
 @dataclass(frozen=True)
 class Threshold:
@@ -103,6 +112,26 @@ THRESHOLDS: Tuple[Threshold, ...] = (
         observable="fraction of recorded spans the ring overwrote",
         description="a trace with more holes than this under-counts "
                     "exactly the longest runs"),
+    Threshold(
+        name="ttft", env="TPUDIST_TTFT_P99_MAX",
+        default=TTFT_P99_MAX, sense="max", alert=True,
+        observable="p99 time-to-first-token in seconds (queue wait + "
+                   "prefill)",
+        description="users feel the first token; past this the serving "
+                    "pod is admission- or prefill-bound"),
+    Threshold(
+        name="itl", env="TPUDIST_ITL_P99_MAX",
+        default=ITL_P99_MAX, sense="max", alert=True,
+        observable="p99 inter-token latency in seconds (decode "
+                   "superstep wall / steps)",
+        description="token streaming stutters past this; the decode "
+                    "program or batch shape is mis-sized"),
+    Threshold(
+        name="tokens_per_chip", env="TPUDIST_TOKENS_PER_CHIP_MIN",
+        default=TOKENS_PER_CHIP_MIN, sense="min", alert=True,
+        observable="generated tokens per second per chip",
+        description="below this floor the pod serves fewer users than "
+                    "its chip count should carry"),
 )
 
 ALERT_RULES: Tuple[Threshold, ...] = tuple(

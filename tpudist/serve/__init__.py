@@ -1,0 +1,32 @@
+"""tpudist.serve — batched inference engine with latency-SLO verdicts.
+
+The fourth subsystem beside ``elastic/``, ``tune/`` and ``obs/``: the
+"millions of users" half of the north star. A prefill/decode-split
+engine over the training models (``models.transformer`` / ``models.moe``
+grow a cache-aware incremental path — serve does not fork the model),
+with
+
+* an incremental KV cache sharded on the existing mesh machinery
+  (per-sequence slots, GQA-compact head layout,
+  ``parallel.sharding.kv_cache_specs``) — :mod:`tpudist.serve.kvcache`;
+* exactly TWO compiled programs per run — one prefill, one ``lax.scan``
+  decode superstep over the whole slot batch — :mod:`tpudist.serve.engine`;
+* a continuous-batching scheduler: Poisson arrivals, admission into
+  free slots, mid-scan completion — :mod:`tpudist.serve.scheduler`;
+* latency-SLO verdicts (p50/p99 TTFT, inter-token latency, tokens/s/chip)
+  through the shared :mod:`tpudist.rules` table —
+  :mod:`tpudist.serve.slo`;
+* a measured-probe autotuner for decode batch size and KV layout on the
+  PR-4 fingerprint-cache machinery — :mod:`tpudist.serve.tune`.
+
+Entry point: ``python -m tpudist.serve`` (:mod:`tpudist.serve.cli`).
+
+This ``__init__`` stays jax-free (only :mod:`tpudist.serve.slo` is
+imported eagerly): the offline report CLI imports the SLO math on
+machines with no accelerator stack installed.
+"""
+
+from tpudist.serve.slo import (LatencyStats, grade, percentile,  # noqa: F401
+                               serve_status)
+
+__all__ = ["LatencyStats", "grade", "percentile", "serve_status"]

@@ -1,0 +1,5 @@
+import sys
+
+from tpudist.serve.cli import main
+
+sys.exit(main())

@@ -1,0 +1,285 @@
+"""``python -m tpudist.serve`` — the serving acceptance lane.
+
+One command drives the whole serve stack end to end on whatever mesh
+the platform gives it (the scripted CPU mesh in CI, a pod slice under
+``launch_tpu.sh MODE=serve``): build the model and its sharded KV
+cache, warm the two compiled programs, optionally let the serve
+autotuner pick ``decode_k``/layout by measured probe, run the
+continuous-batching loop over a seeded Poisson request stream, and
+grade the latency SLOs.
+
+Artifacts mirror the train lane's: ``metrics.jsonl`` (``kind=serve`` /
+``serve_tick`` / ``serve_tune`` records) under ``--save-dir``, a
+worker trace when ``--trace-dir`` is set (``prefill`` / ``decode_step``
+/ ``admit`` spans), an optional ``BENCH_SERVE.json`` (``--bench-out``),
+a Prometheus exporter while the run lives (``--live-port``), and the
+machine-readable verdict file (``TPUDIST_VERDICT_PATH``) carrying the
+three-valued SLO verdict. Exit code: 0 unless an SLO gate FAILED — an
+ungateable run (nothing measured) is not a latency regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, Optional, Sequence
+
+from tpudist.serve import slo as slo_lib
+
+DEFAULT_SLOTS = 4
+DEFAULT_MAX_SEQ = 64
+DEFAULT_PROMPT_PAD = 16
+DEFAULT_DECODE_K = 8
+
+
+def parse_args(argv: Optional[Sequence[str]] = None
+               ) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.serve",
+        description="tpudist serving acceptance lane: continuous "
+                    "batching + sharded KV cache + latency-SLO verdict")
+    p.add_argument("--model", choices=("transformer", "moe"),
+                   default="transformer")
+    p.add_argument("--vocab-size", type=int, default=256)
+    p.add_argument("--n-layers", type=int, default=2)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--n-heads", type=int, default=4)
+    p.add_argument("--n-kv-heads", type=int, default=2,
+                   help="GQA: compact kv heads stored in the cache")
+    p.add_argument("--d-ff", type=int, default=128)
+    p.add_argument("--n-experts", type=int, default=4)
+    p.add_argument("--expert-top-k", type=int, default=2)
+    p.add_argument("--slots", type=int, default=DEFAULT_SLOTS,
+                   help="concurrent sequences (KV cache pages)")
+    p.add_argument("--max-seq", type=int, default=DEFAULT_MAX_SEQ,
+                   help="per-slot cache page length")
+    p.add_argument("--prompt-pad", type=int, default=DEFAULT_PROMPT_PAD,
+                   help="static prompt width every admission pads to "
+                        "(one compiled prefill program)")
+    p.add_argument("--decode-steps-per-dispatch", type=int,
+                   default=DEFAULT_DECODE_K, dest="decode_k",
+                   help="decode superstep length (tokens per dispatch "
+                        "per slot)")
+    p.add_argument("--kv-layout", choices=("st", "hs"), default="st",
+                   help="KV cache physical storage layout "
+                        "(tpudist.serve.kvcache)")
+    p.add_argument("--requests", type=int, default=32,
+                   help="synthetic request count")
+    p.add_argument("--request-rate", type=float, default=0.0,
+                   help="Poisson arrival rate in requests/s "
+                        "(<= 0: closed loop, all present at t=0)")
+    p.add_argument("--max-new-tokens", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--serve-tune", choices=("off", "probe", "cache-only"),
+                   default=os.environ.get("TPUDIST_SERVE_TUNE", "off"),
+                   help="autotune decode_k/kv-layout by measured probe "
+                        "(tpudist.serve.tune; $TPUDIST_SERVE_TUNE)")
+    p.add_argument("--tune-cache-dir", type=str, default=None,
+                   help="serve tuner cache dir (default "
+                        "$TPUDIST_AUTOTUNE_CACHE_DIR, else "
+                        "<save-dir>/tune — shared with the train tuner, "
+                        "distinct file prefix)")
+    p.add_argument("--save-dir", type=str, default="ckpt",
+                   help="metrics.jsonl destination")
+    p.add_argument("--bench-out", type=str, default=None,
+                   help="write the run summary as BENCH_SERVE.json here")
+    p.add_argument("--trace-dir", type=str,
+                   default=os.environ.get("TPUDIST_TRACE_DIR"),
+                   help="span-trace export dir ($TPUDIST_TRACE_DIR)")
+    p.add_argument("--live-port", type=int, default=_env_int(
+        "TPUDIST_LIVE_PORT"),
+        help="serve Prometheus /metrics + /status.json on this port "
+             "while the run lives ($TPUDIST_LIVE_PORT)")
+    return p.parse_args(argv)
+
+
+def _env_int(name: str) -> Optional[int]:
+    raw = os.environ.get(name)
+    try:
+        return int(raw) if raw else None
+    except ValueError:
+        return None
+
+
+class _LoopbackEmitter:
+    """MetricsLogger→LiveAggregator fan-out without a socket: the serve
+    CLI is single-process, so the coordinator IS the worker and records
+    can be ingested directly (same record shapes the TCP bus carries)."""
+
+    def __init__(self, agg):
+        self.agg = agg
+
+    def emit(self, rec: Dict[str, Any]) -> None:
+        try:
+            self.agg.ingest(rec)
+        except Exception:
+            pass   # telemetry must never take down the serve loop
+
+
+def run(args: argparse.Namespace) -> Dict[str, Any]:
+    import jax
+
+    from tpudist.config import ModelConfig, ParallelConfig
+    from tpudist.metrics import MetricsLogger, log0
+    from tpudist.obs import live as live_lib
+    from tpudist.obs import trace as trace_lib
+    from tpudist.parallel.mesh import build_mesh
+    from tpudist.serve import scheduler as sched
+    from tpudist.serve import tune as serve_tune
+    from tpudist.serve.engine import ServeEngine, init_params
+
+    model_cfg = ModelConfig(
+        name=args.model, vocab_size=args.vocab_size,
+        n_layers=args.n_layers, d_model=args.d_model,
+        n_heads=args.n_heads, n_kv_heads=args.n_kv_heads,
+        d_ff=args.d_ff, max_seq_len=args.max_seq,
+        n_experts=args.n_experts, expert_top_k=args.expert_top_k)
+    mesh = build_mesh(ParallelConfig())
+    tracer = trace_lib.configure(enabled=bool(args.trace_dir))
+
+    os.makedirs(args.save_dir, exist_ok=True)
+    metrics = MetricsLogger(
+        path=os.path.join(args.save_dir, "metrics.jsonl"))
+    run_id = live_lib.resolve_run_id(jax.process_count())
+    metrics.extra["run_id"] = run_id
+
+    agg = server = None
+    if args.live_port:
+        agg = live_lib.LiveAggregator(out_dir=args.save_dir,
+                                      run_id=run_id, metrics=None,
+                                      stall_timeout_s=0)
+        server = live_lib.LiveHttpServer(agg, port=args.live_port)
+        metrics.emitter = _LoopbackEmitter(agg)
+        log0(f"tpudist: serve live exporter on :{server.port}/metrics")
+
+    params = init_params(model_cfg, mesh, seed=args.seed)
+
+    cand = serve_tune.ServeCandidate(decode_k=args.decode_k,
+                                     layout=args.kv_layout)
+    if args.serve_tune != "off":
+        cache_dir = (args.tune_cache_dir
+                     or os.environ.get("TPUDIST_AUTOTUNE_CACHE_DIR")
+                     or os.path.join(args.save_dir, "tune"))
+        with trace_lib.span("serve_tune", cat="tune",
+                            mode=args.serve_tune):
+            out = serve_tune.autotune_serve(
+                model_cfg, mesh, params, slots=args.slots,
+                max_seq=args.max_seq, prompt_pad=args.prompt_pad,
+                mode=args.serve_tune, cache_dir=cache_dir, start=cand,
+                metrics=metrics)
+        cand = out.tuned
+        log0(f"tpudist: serve tune {out.status} ({out.source}): "
+             f"decode_k={cand.decode_k} layout={cand.layout} "
+             f"[{out.trials} trial(s)]")
+
+    engine = ServeEngine(model_cfg, mesh, slots=args.slots,
+                         max_seq=args.max_seq,
+                         prompt_pad=args.prompt_pad,
+                         decode_k=cand.decode_k, layout=cand.layout)
+    with trace_lib.span("serve_warmup", cat="serve"):
+        engine.warmup(params)
+
+    requests = sched.make_requests(
+        args.requests, prompt_pad=args.prompt_pad,
+        vocab_size=args.vocab_size, max_new=args.max_new_tokens,
+        rate=args.request_rate, seed=args.seed)
+    summary = sched.run_serve(engine, params, requests, metrics=metrics)
+    engine.assert_two_programs()
+
+    summary["run_id"] = run_id
+    summary["model"] = args.model
+    cache_bytes = engine.spec.bytes
+    summary["kv_cache_bytes"] = cache_bytes
+    metrics.log(kind="serve",
+                **{k: v for k, v in summary.items()
+                   if k not in ("results", "alert_events", "thresholds")})
+    metrics.flush()
+
+    log0(f"tpudist: serve {summary['status']}: "
+         f"{summary['completed']}/{summary['requests']} requests, "
+         f"{summary['generated_tokens']} tokens in "
+         f"{summary['wall_s']:.3f}s "
+         f"({summary['tokens_per_sec_per_chip']} tok/s/chip), "
+         f"ttft p99 {summary['ttft_p99_s']}s, "
+         f"itl p99 {summary['itl_p99_s']}s "
+         f"[{summary['prefill_compiles']} prefill / "
+         f"{summary['decode_compiles']} decode compile(s), "
+         f"kv cache {cache_bytes / 2**20:.2f} MB]")
+
+    if args.bench_out:
+        _write_bench(args.bench_out, args, summary)
+        log0(f"tpudist: serve bench -> {args.bench_out}")
+
+    if args.trace_dir:
+        os.makedirs(args.trace_dir, exist_ok=True)
+        tracer.export_local(
+            os.path.join(args.trace_dir, trace_lib.worker_trace_name(
+                jax.process_index())),
+            process_index=jax.process_index())
+    if server is not None:
+        server.close()
+    if agg is not None:
+        agg.close()
+    metrics.close()
+    return summary
+
+
+def _write_bench(path: str, args: argparse.Namespace,
+                 summary: Dict[str, Any]) -> None:
+    """BENCH_SERVE.json — same harness shape as the other BENCH_*
+    artifacts: one metric headline, per-gate detail, thresholds."""
+    import jax
+    doc = {
+        "metric": "serve_tokens_per_sec_per_chip",
+        "value": summary["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip",
+        "detail": {k: summary.get(k) for k in (
+            "run_id", "model", "requests", "completed",
+            "generated_tokens", "truncated", "wall_s", "dispatches",
+            "slots", "decode_k", "kv_layout", "kv_cache_bytes",
+            "tokens_per_sec", "queue_depth_max", "queue_depth_mean",
+            "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
+            "e2e_p50_s", "e2e_p99_s", "prefill_compiles",
+            "decode_compiles", "n_chips")},
+        "slo": slo_lib.slo_block(summary),
+        "device": jax.devices()[0].device_kind,
+    }
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=1)
+    os.replace(tmp, path)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    from tpudist.utils import (maybe_enable_compilation_cache,
+                               maybe_force_platform, tune_tpu)
+    maybe_force_platform()
+    tune_tpu()
+    maybe_enable_compilation_cache()
+    args = parse_args(argv)
+    verdict_path = os.environ.get("TPUDIST_VERDICT_PATH")
+    status = slo_lib.FAIL
+    try:
+        summary = run(args)
+        status = summary["status"]
+    except Exception as e:
+        print(f"tpudist: serve failed: {e!r}", file=sys.stderr,
+              flush=True)
+    if verdict_path:
+        try:
+            from tpudist import verdict as verdict_lib
+            verdict_lib.write_final_status(verdict_path, status)
+        except Exception as e:
+            print(f"tpudist: verdict plumbing failed: {e!r}",
+                  file=sys.stderr, flush=True)
+    # an UNGATEABLE run (nothing measured) is not a latency regression
+    return 1 if status == slo_lib.FAIL else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,254 @@
+"""The prefill/decode-split serving engine: exactly TWO compiled programs.
+
+The pjit/TPUv4 discipline that keeps the training loop honest (one
+compiled program per run, traced scalars for everything that varies)
+applies doubly to serving, where continuous batching changes the live
+request set every few milliseconds: a recompile per admission would
+bury the latency SLO. So the engine compiles exactly two programs and
+pins it (``prefill.traces`` / ``decode.traces``, asserted in tests and
+the CI lane):
+
+* **prefill** — one request into one slot: full causal forward over the
+  padded prompt (the model's cache-aware path — ``hidden_states(...,
+  kv_cache=)`` — seeds the slot's KV columns), first token by greedy
+  argmax at the prompt's true last position. Slot index, prompt length
+  and the generation budget are traced scalars; the prompt is padded to
+  a fixed ``prompt_pad`` so every admission reuses the one program.
+* **decode** — a ``lax.scan`` superstep of ``decode_k`` steps over the
+  WHOLE slot batch. Per-slot active masks (``jnp.where`` on every state
+  update) keep finished/empty slots frozen, and a ``lax.cond`` skips an
+  iteration outright when NO slot is active (mid-scan completion of the
+  last request — the same masking discipline that kept PR 2's padded
+  superstep bitwise) — so one compiled program serves every batch
+  occupancy from full to empty.
+
+Greedy decoding is a pure function of (params, state), so runs are
+bitwise reproducible; decode-with-cache logits are pinned ULP-close to
+the full forward (tests/test_serve.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpudist.config import ModelConfig
+from tpudist.models import get_model
+from tpudist.parallel import sharding as shd
+from tpudist.serve import kvcache
+
+
+class ServeState(NamedTuple):
+    """Device-resident serving state — the scan carry of the decode
+    superstep and the donation target of both programs."""
+
+    cache_k: jax.Array       # (L, slots, ...) in the storage layout
+    cache_v: jax.Array
+    lengths: jax.Array       # (slots,) int32: tokens in cache per slot
+    last_token: jax.Array    # (slots,) int32: newest token, not yet cached
+    active: jax.Array        # (slots,) bool: slot holds a live sequence
+    remaining: jax.Array     # (slots,) int32: generation budget left
+
+
+def init_params(model_cfg: ModelConfig, mesh, seed: int = 0):
+    """Seeded model params placed to their sanitised param_specs layout
+    — the same init + sharding recipe the training engine uses, minus
+    the optimizer state serving has no use for."""
+    model = get_model(model_cfg.name)
+    params = model.init(jax.random.PRNGKey(seed), model_cfg)
+    shape = jax.eval_shape(
+        lambda: model.init(jax.random.PRNGKey(0), model_cfg))
+    pspecs = shd.sanitize_specs(shape, model.param_specs(model_cfg), mesh)
+    return jax.device_put(params, shd.named(mesh, pspecs))
+
+
+class ServeEngine:
+    """Builds and owns the two compiled programs plus the state layout.
+
+    ``prompt_pad`` is the static prompt width every admission pads to;
+    ``decode_k`` the superstep length (tokens per dispatch per slot);
+    ``layout`` the KV storage layout (:mod:`tpudist.serve.kvcache`).
+    """
+
+    def __init__(self, model_cfg: ModelConfig, mesh, *, slots: int,
+                 max_seq: int, prompt_pad: int, decode_k: int = 8,
+                 layout: str = "st", dtype=jnp.float32):
+        if slots < 1:
+            raise ValueError(f"--slots must be >= 1, got {slots}")
+        if decode_k < 1:
+            raise ValueError(
+                f"--decode-steps-per-dispatch must be >= 1, got {decode_k}")
+        if not 0 < prompt_pad <= max_seq:
+            raise ValueError(
+                f"prompt_pad {prompt_pad} must be in (0, max_seq "
+                f"{max_seq}]")
+        self.model_cfg = model_cfg
+        self.model = get_model(model_cfg.name)
+        self.mesh = mesh
+        self.slots, self.max_seq = int(slots), int(max_seq)
+        self.prompt_pad, self.decode_k = int(prompt_pad), int(decode_k)
+        self.layout, self.dtype = layout, dtype
+        self.spec = kvcache.CacheSpec.from_model(
+            model_cfg, slots=slots, max_seq=max_seq, dtype=dtype,
+            layout=layout)
+        self.prefill_traces: list = []
+        self.decode_traces: list = []
+        self._prefill = jax.jit(self._prefill_body, donate_argnums=(1,))
+        self._decode = jax.jit(self._decode_body, donate_argnums=(1,))
+
+    # ----------------------------------------------------------- state
+
+    def init_state(self) -> ServeState:
+        cache = kvcache.init_cache(self.spec, self.mesh)
+        rep = shd.replicated(self.mesh)
+        vec = lambda v: jax.device_put(v, rep)
+        s = self.slots
+        return ServeState(
+            cache_k=cache["k"], cache_v=cache["v"],
+            lengths=vec(jnp.zeros((s,), jnp.int32)),
+            last_token=vec(jnp.zeros((s,), jnp.int32)),
+            active=vec(jnp.zeros((s,), bool)),
+            remaining=vec(jnp.zeros((s,), jnp.int32)))
+
+    # --------------------------------------------------------- prefill
+
+    def _tied_logits(self, params, h):
+        emb = params["embed"].astype(self.dtype)
+        return (h @ emb.T).astype(jnp.float32)
+
+    def _prefill_body(self, params, state: ServeState, tokens,
+                      prompt_len, slot, max_new
+                      ) -> Tuple[ServeState, jax.Array]:
+        self.prefill_traces.append(1)   # trace-time compile marker
+        # the slot's cache page, in canonical layout for the model
+        ck = lax.dynamic_slice_in_dim(state.cache_k, slot, 1, axis=1)
+        cv = lax.dynamic_slice_in_dim(state.cache_v, slot, 1, axis=1)
+        cache = {"k": kvcache.to_canonical(ck, self.layout),
+                 "v": kvcache.to_canonical(cv, self.layout)}
+        h, cache = self.model.hidden_states(
+            params, tokens, self.model_cfg, dtype=self.dtype,
+            kv_cache=cache, cur_index=None)
+        # greedy first token from the prompt's true last position — the
+        # padded tail's hidden states exist but are never consulted
+        h_last = lax.dynamic_index_in_dim(h, prompt_len - 1, axis=1,
+                                          keepdims=False)
+        first = jnp.argmax(self._tied_logits(params, h_last),
+                           axis=-1).astype(jnp.int32)[0]
+        zeros = (0,) * (state.cache_k.ndim - 2)
+        ck = lax.dynamic_update_slice(
+            state.cache_k, kvcache.from_canonical(cache["k"], self.layout),
+            (0, slot) + zeros)
+        cv = lax.dynamic_update_slice(
+            state.cache_v, kvcache.from_canonical(cache["v"], self.layout),
+            (0, slot) + zeros)
+        rem = max_new - 1            # the prefill itself produced token 1
+        active = (rem > 0) & (prompt_len < self.max_seq)
+        return ServeState(
+            cache_k=ck, cache_v=cv,
+            lengths=state.lengths.at[slot].set(prompt_len),
+            last_token=state.last_token.at[slot].set(first),
+            active=state.active.at[slot].set(active),
+            remaining=state.remaining.at[slot].set(
+                jnp.where(active, rem, 0))), first
+
+    def prefill(self, params, state: ServeState, tokens, prompt_len: int,
+                slot: int, max_new: int) -> Tuple[ServeState, jax.Array]:
+        """Admit one request into ``slot``. ``tokens`` is the padded
+        (1, prompt_pad) prompt; scalars go in as traced int32 so every
+        admission reuses the one compiled program. Returns the updated
+        state and the request's FIRST generated token (a device scalar
+        — ``int()`` it to fence)."""
+        tokens = jnp.asarray(tokens, jnp.int32).reshape(1, self.prompt_pad)
+        return self._prefill(params, state, tokens,
+                             jnp.int32(prompt_len), jnp.int32(slot),
+                             jnp.int32(max_new))
+
+    # ---------------------------------------------------------- decode
+
+    def _decode_body(self, params, state: ServeState
+                     ) -> Tuple[ServeState, jax.Array, jax.Array]:
+        self.decode_traces.append(1)    # trace-time compile marker
+        slots = self.slots
+
+        def step(st: ServeState, _):
+            def run(st: ServeState):
+                # write position per slot; inactive slots' (discarded)
+                # junk write is clamped in-bounds so a completed full
+                # slot can never scatter out of range
+                pos = jnp.minimum(st.lengths, self.max_seq - 1)
+                cache = {"k": kvcache.to_canonical(st.cache_k,
+                                                   self.layout),
+                         "v": kvcache.to_canonical(st.cache_v,
+                                                   self.layout)}
+                h, cache = self.model.hidden_states(
+                    params, st.last_token[:, None], self.model_cfg,
+                    dtype=self.dtype, kv_cache=cache, cur_index=pos)
+                nxt = jnp.argmax(self._tied_logits(params, h[:, 0]),
+                                 axis=-1).astype(jnp.int32)
+                act = st.active
+                new_len = jnp.where(act, st.lengths + 1, st.lengths)
+                new_rem = jnp.where(act, st.remaining - 1, st.remaining)
+                new_state = ServeState(
+                    cache_k=kvcache.from_canonical(cache["k"],
+                                                   self.layout),
+                    cache_v=kvcache.from_canonical(cache["v"],
+                                                   self.layout),
+                    lengths=new_len,
+                    last_token=jnp.where(act, nxt, st.last_token),
+                    # a slot completes on budget exhaustion or a full
+                    # cache page (forced eviction at max_seq)
+                    active=act & (new_rem > 0) & (new_len < self.max_seq),
+                    remaining=new_rem)
+                return new_state, jnp.where(act, nxt, -1), act
+
+            def skip(st: ServeState):
+                # nothing active (the batch emptied mid-scan): pass the
+                # state through untouched — same cond discipline that
+                # kept the training superstep's padded tail bitwise
+                return (st, jnp.full((slots,), -1, jnp.int32),
+                        jnp.zeros((slots,), bool))
+
+            st, tok, valid = lax.cond(st.active.any(), run, skip, st)
+            return st, (tok, valid)
+
+        state, (toks, valid) = lax.scan(step, state, None,
+                                        length=self.decode_k)
+        return state, toks, valid
+
+    def decode(self, params, state: ServeState
+               ) -> Tuple[ServeState, jax.Array, jax.Array]:
+        """One decode superstep: up to ``decode_k`` tokens for every
+        active slot. Returns ``(state, tokens (k, slots), valid (k,
+        slots))`` — entries with ``valid=False`` are placeholders (-1)
+        and must not be read. Async: fence on the returned tokens."""
+        return self._decode(params, state)
+
+    # ---------------------------------------------------------- warmup
+
+    def warmup(self, params) -> None:
+        """Compile both programs OFF the request clock: a cold first
+        admission would charge XLA compilation to that request's TTFT.
+        Runs a dummy prefill + one decode superstep on a throwaway
+        state (donated away), fences, and leaves both jit caches warm —
+        after this, a whole serve run compiles nothing
+        (``assert_two_programs``)."""
+        state = self.init_state()
+        dummy = jnp.zeros((1, self.prompt_pad), jnp.int32)
+        state, first = self.prefill(params, state, dummy, 1, 0, 2)
+        state, toks, valid = self.decode(params, state)
+        jax.device_get((first, toks, valid))
+
+    def compile_counts(self) -> Tuple[int, int]:
+        return len(self.prefill_traces), len(self.decode_traces)
+
+    def assert_two_programs(self) -> None:
+        """The compiled-program pin: one prefill + one decode trace for
+        the whole run, warmup included."""
+        p, d = self.compile_counts()
+        if (p, d) != (1, 1):
+            raise AssertionError(
+                f"serve engine compiled {p} prefill / {d} decode "
+                f"program(s); the two-program contract is broken")

@@ -1,0 +1,119 @@
+"""Sharded per-sequence KV cache for the serving engine.
+
+The cache is the serving engine's whole working state: one K and one V
+array of canonical shape ``(n_layers, slots, max_seq, n_kv_heads,
+head_dim)``. Slots are per-SEQUENCE pages — a request is admitted into a
+free slot, decodes in place, and frees the slot on completion; stale
+rows beyond a slot's current length are never read (the decode mask is
+``key_pos <= cur_index``), so admission never needs to zero anything.
+
+GQA-aware by construction: the cache stores the COMPACT kv heads (the
+same layout the models' ``wk``/``wv`` produce) and expansion to the
+query head count happens inside the attention math — an 8×-grouped
+model's cache is 8× smaller than a naive full-head cache, which is the
+difference between fitting long contexts in HBM or not.
+
+Sharding rides the existing mesh machinery: ``parallel.sharding.
+kv_cache_specs`` is the ``param_specs``-style single source for the
+PartitionSpec (slots over the batch axes, kv heads over tensor),
+sanitised per-mesh exactly like model params.
+
+``layout`` is a PHYSICAL storage knob the serve autotuner probes:
+``"st"`` (canonical, seq-major) or ``"hs"`` (heads-major). The models'
+cache API always sees canonical; :func:`to_canonical` /
+:func:`from_canonical` transpose inside the compiled program, so the
+layout's real cost/benefit is exactly what the probe measures.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpudist.config import ModelConfig
+from tpudist.parallel import sharding as shd
+from tpudist.parallel.sharding import KV_CACHE_LAYOUTS  # noqa: F401
+
+
+@dataclasses.dataclass(frozen=True)
+class CacheSpec:
+    """Static shape/dtype/layout of one serving run's KV cache."""
+
+    n_layers: int
+    slots: int
+    max_seq: int
+    n_kv_heads: int
+    head_dim: int
+    dtype: Any = jnp.float32
+    layout: str = "st"
+
+    @classmethod
+    def from_model(cls, cfg: ModelConfig, *, slots: int, max_seq: int,
+                   dtype=jnp.float32, layout: str = "st") -> "CacheSpec":
+        return cls(n_layers=cfg.n_layers, slots=slots, max_seq=max_seq,
+                   n_kv_heads=cfg.n_kv_heads,
+                   head_dim=cfg.d_model // cfg.n_heads,
+                   dtype=dtype, layout=layout)
+
+    @property
+    def canonical_shape(self) -> tuple:
+        return (self.n_layers, self.slots, self.max_seq,
+                self.n_kv_heads, self.head_dim)
+
+    @property
+    def storage_shape(self) -> tuple:
+        l, s, t, h, d = self.canonical_shape
+        return (l, s, t, h, d) if self.layout == "st" else (l, s, h, t, d)
+
+    @property
+    def bytes(self) -> int:
+        """Total cache footprint (K + V) — the number an operator sizes
+        slots × max_seq against HBM with."""
+        n = 1
+        for d in self.canonical_shape:
+            n *= d
+        return 2 * n * jnp.dtype(self.dtype).itemsize
+
+
+def to_canonical(arr: jax.Array, layout: str) -> jax.Array:
+    """Storage layout → canonical (L, slots, seq, kv_heads, head_dim).
+    A no-op for ``"st"``; ``"hs"`` transposes (the swap is its own
+    inverse, so one permutation serves both directions)."""
+    if layout == "st":
+        return arr
+    if layout == "hs":
+        return jnp.transpose(arr, (0, 1, 3, 2, 4))
+    raise ValueError(f"unknown kv-cache layout {layout!r}: "
+                     f"{' | '.join(KV_CACHE_LAYOUTS)}")
+
+
+def from_canonical(arr: jax.Array, layout: str) -> jax.Array:
+    """Canonical → storage layout (see :func:`to_canonical`)."""
+    return to_canonical(arr, layout)
+
+
+def cache_shardings(spec: CacheSpec, mesh) -> Any:
+    """NamedSharding for the K/V arrays on ``mesh``, sanitised like
+    model params (a slot count the batch axes don't divide falls back
+    to replicated instead of erroring)."""
+    shape = jax.ShapeDtypeStruct(spec.storage_shape, spec.dtype)
+    pspec = shd.sanitize_specs(
+        shape, shd.kv_cache_specs(spec.layout), mesh)
+    return shd.named(mesh, pspec)
+
+
+def init_cache(spec: CacheSpec, mesh=None) -> Dict[str, jax.Array]:
+    """Zero-initialised ``{"k", "v"}`` cache in the storage layout,
+    placed to its mesh sharding when one is given. Zeros are never read
+    (the length mask guards every slot), but a deterministic initial
+    value keeps the whole serve run a pure function of (params, seed)."""
+    k = jnp.zeros(spec.storage_shape, spec.dtype)
+    v = jnp.zeros(spec.storage_shape, spec.dtype)
+    if mesh is not None:
+        sh = cache_shardings(spec, mesh)
+        k = jax.device_put(k, sh)
+        v = jax.device_put(v, sh)
+    return {"k": k, "v": v}

@@ -1,0 +1,273 @@
+"""Continuous batching: Poisson arrivals, slot admission, SLO accounting.
+
+The host half of the serving engine. Requests arrive on an open-loop
+Poisson schedule (a synthetic stand-in for "millions of users" — rate,
+prompt lengths and generation budgets are all seeded, so a serve run is
+reproducible end to end), queue until a slot frees, prefill into the
+free slot, and decode continuously: every dispatch is one compiled
+superstep over the WHOLE slot batch, with completed slots freed and
+refilled between dispatches — no draining, no batch reshaping, no
+recompiles.
+
+Latency accounting happens here because only the host sees the request
+clock: TTFT spans arrival → the fenced prefill that produced the first
+token (queue wait included); ITL attributes each token in a decode
+dispatch ``dispatch_wall / decode_k`` (see :mod:`tpudist.serve.slo`).
+The loop feeds every observation to an :class:`~tpudist.obs.alerts.
+AlertEngine` over the shared rules table, so an SLO breach FIRES as an
+alert mid-run — same numbers, same thresholds as the exit verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from tpudist import rules as rules_lib
+from tpudist.obs import trace as trace_lib
+from tpudist.obs.alerts import AlertEngine
+from tpudist.serve import slo as slo_lib
+from tpudist.serve.engine import ServeEngine
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One synthetic inference request."""
+
+    rid: int
+    arrival_s: float          # offset from run start
+    tokens: np.ndarray        # (prompt_pad,) int32, padded prompt
+    prompt_len: int
+    max_new: int
+
+
+def make_requests(n: int, *, prompt_pad: int, vocab_size: int,
+                  max_new: int, rate: float, seed: int,
+                  prompt_min: int = 0) -> List[Request]:
+    """Seeded synthetic request stream.
+
+    Arrivals: Poisson process at ``rate`` requests/s (exponential
+    inter-arrival gaps); ``rate <= 0`` means every request is present at
+    t=0 — the closed-loop mode benchmarks and probes use. Prompts reuse
+    the training data's deterministic next-token structure (the affine
+    map of data.make_synthetic_tokens) with per-request lengths drawn
+    from [prompt_min, prompt_pad]."""
+    rng = np.random.default_rng(seed)
+    if rate > 0:
+        arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
+    else:
+        arrivals = np.zeros(n)
+    prompt_min = min(max(1, prompt_min or prompt_pad // 2), prompt_pad)
+    lens = rng.integers(prompt_min, prompt_pad + 1, size=n)
+    first = rng.integers(0, vocab_size, size=(n, 1)).astype(np.int32)
+    toks = np.empty((n, prompt_pad), np.int32)
+    toks[:, :1] = first
+    for t in range(1, prompt_pad):
+        toks[:, t] = (toks[:, t - 1] * 7 + 3) % vocab_size
+    out = []
+    for i in range(n):
+        padded = toks[i].copy()
+        padded[lens[i]:] = 0     # pad-token tail, masked by prompt_len
+        out.append(Request(rid=i, arrival_s=float(arrivals[i]),
+                           tokens=padded, prompt_len=int(lens[i]),
+                           max_new=int(max_new)))
+    return out
+
+
+@dataclasses.dataclass
+class _Slot:
+    req: Request
+    generated: int
+    first_token_s: float
+    output: List[int]
+
+
+def run_serve(engine: ServeEngine, params, requests: List[Request], *,
+              metrics: Any = None, tick_every: int = 8,
+              clock: Callable[[], float] = time.perf_counter,
+              n_chips: Optional[int] = None) -> Dict[str, Any]:
+    """Drive the engine over the request stream; returns the run summary
+    (percentiles, throughput, per-gate SLO statuses, compile counts).
+
+    The engine must already be warmed (:meth:`ServeEngine.warmup`) so
+    the request clock never pays XLA compilation. ``metrics`` (a
+    MetricsLogger) receives periodic ``kind=serve_tick`` records; the
+    caller logs the final ``kind=serve`` summary so it can stamp its own
+    fields in."""
+    import jax
+    if n_chips is None:
+        n_chips = max(jax.device_count(), 1)
+    tracer = trace_lib.get()
+    stats = slo_lib.LatencyStats()
+    alerts = AlertEngine()
+    queue = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+    slots: List[Optional[_Slot]] = [None] * engine.slots
+    state = engine.init_state()
+    results: Dict[int, Dict[str, Any]] = {}
+    generated = truncated = dispatches = 0
+    queue_depths: List[int] = []
+    t0 = clock()
+
+    def now() -> float:
+        return clock() - t0
+
+    def finish(i: int, why: str) -> None:
+        nonlocal truncated
+        s = slots[i]
+        results[s.req.rid] = {
+            "tokens": list(s.output), "prompt_len": s.req.prompt_len,
+            "generated": s.generated, "why": why,
+            "e2e_s": now() - s.req.arrival_s}
+        stats.note_e2e(now() - s.req.arrival_s)
+        if why == "evicted":
+            truncated += 1
+        slots[i] = None
+
+    def admit() -> None:
+        nonlocal generated, state
+        t = now()
+        for i in range(engine.slots):
+            if slots[i] is not None or not queue \
+                    or queue[0].arrival_s > t:
+                continue
+            req = queue.popleft()
+            with tracer.span("admit", cat="serve", rid=req.rid, slot=i):
+                pass   # the admission decision itself is host-trivial
+            with tracer.span("prefill", cat="serve", rid=req.rid,
+                             slot=i, prompt_len=req.prompt_len):
+                state, first = engine.prefill(
+                    params, state, req.tokens[None, :], req.prompt_len,
+                    i, req.max_new)
+                first = int(first)           # fence: the token exists NOW
+            t_first = now()
+            stats.note_ttft(t_first - req.arrival_s)
+            generated += 1
+            slots[i] = _Slot(req=req, generated=1, first_token_s=t_first,
+                             output=[first])
+            if req.max_new <= 1 or req.prompt_len >= engine.max_seq:
+                finish(i, "done" if req.max_new <= 1 else "evicted")
+            t = now()
+
+    def arrived_depth() -> int:
+        # ONLY requests whose arrival time has passed: the deque holds
+        # the whole future synthetic schedule, and "queued" must mean
+        # waiting-for-a-slot, not not-yet-generated (the Prometheus
+        # gauge and the report's queue_over_time both promise that)
+        t = now()
+        n = 0
+        for r in queue:            # arrival-sorted: break at the future
+            if r.arrival_s > t:
+                break
+            n += 1
+        return n
+
+    def observe_slos(summ: Dict[str, Any]) -> None:
+        alerts.observe("ttft", summ["ttft_p99_s"])
+        alerts.observe("itl", summ["itl_p99_s"])
+        wall = now()
+        if wall > 0 and generated:
+            alerts.observe("tokens_per_chip",
+                           generated / wall / n_chips)
+
+    while len(results) < len(requests):
+        admit()
+        occupied = [i for i in range(engine.slots) if slots[i] is not None]
+        if not occupied:
+            # nothing running and nothing arrived yet: wait out the gap
+            # to the next scheduled arrival (bounded — the generator's
+            # schedule is finite)
+            if queue:
+                time.sleep(min(0.002, max(0.0,
+                                          queue[0].arrival_s - now())))
+                continue
+            break
+        # depth sampled once per DISPATCH (not per idle busy-wait pass:
+        # a sparse schedule would drown the mean in idle-gap zeros and
+        # grow the sample list unboundedly)
+        queue_depths.append(arrived_depth())
+        t_dispatch = clock()
+        with tracer.span("decode_step", cat="serve",
+                         active=len(occupied)):
+            state, toks, valid = engine.decode(params, state)
+            toks = np.asarray(toks)          # fence: tokens on host
+            valid = np.asarray(valid)
+        dt = clock() - t_dispatch
+        dispatches += 1
+        per_tok = dt / engine.decode_k
+        for i in occupied:
+            col_valid = valid[:, i]
+            n_new = int(col_valid.sum())
+            if n_new:
+                slots[i].output.extend(
+                    int(t) for t in toks[col_valid, i])
+                slots[i].generated += n_new
+                generated += n_new
+                stats.note_itl(per_tok, n_new)
+            s = slots[i]
+            if s.generated >= s.req.max_new:
+                finish(i, "done")
+            elif s.req.prompt_len + s.generated > engine.max_seq:
+                # aligned with the DEVICE freeze (lengths >= max_seq,
+                # i.e. prompt + generated - 1 tokens cached): the slot
+                # is evicted exactly when its page filled, so truncated
+                # output length does not depend on decode_k and a freed
+                # slot is never still device-active
+                finish(i, "evicted")
+        # SLO grading on the tick cadence, not per dispatch: summary()
+        # sorts every accumulated sample, and that host work would land
+        # in the inter-dispatch gap — inflating the very ITL it grades
+        if dispatches % max(tick_every, 1) != 0:
+            continue
+        summ = stats.summary()
+        observe_slos(summ)
+        if metrics is not None:
+            wall = now()
+            metrics.log(kind="serve_tick", t_s=round(wall, 4),
+                        queue_depth=arrived_depth(),
+                        active_slots=sum(s is not None for s in slots),
+                        completed=len(results),
+                        generated_tokens=generated,
+                        ttft_p99_s=summ["ttft_p99_s"],
+                        itl_p99_s=summ["itl_p99_s"],
+                        tokens_per_sec_per_chip=(
+                            round(generated / wall / n_chips, 3)
+                            if wall > 0 else None))
+
+    wall_s = now()
+    # an empty run measured NOTHING: throughput is None (→ the gate
+    # grades UNGATEABLE, the three-valued contract every tpudist gate
+    # follows), not a 0.0 that would read as an SLO fail
+    tps = (generated / wall_s) if generated and wall_s > 0 else None
+    tps_chip = tps / n_chips if tps is not None else None
+    summ = stats.summary()
+    if requests:
+        observe_slos(summ)   # runs shorter than a tick still fire
+    grade = slo_lib.grade(summ["ttft_p99_s"], summ["itl_p99_s"],
+                          tps_chip)
+    return {
+        "requests": len(requests), "completed": len(results),
+        "generated_tokens": generated, "truncated": truncated,
+        "wall_s": round(wall_s, 4), "dispatches": dispatches,
+        "slots": engine.slots, "decode_k": engine.decode_k,
+        "kv_layout": engine.layout,
+        "tokens_per_sec": round(tps, 3) if tps is not None else None,
+        "tokens_per_sec_per_chip": (round(tps_chip, 3)
+                                    if tps_chip is not None else None),
+        "n_chips": n_chips,
+        "queue_depth_max": max(queue_depths, default=0),
+        "queue_depth_mean": (round(float(np.mean(queue_depths)), 3)
+                             if queue_depths else 0.0),
+        **{k: (round(v, 6) if v is not None else None)
+           for k, v in summ.items()},
+        **grade,
+        "alert_events": alerts.events,
+        "prefill_compiles": engine.compile_counts()[0],
+        "decode_compiles": engine.compile_counts()[1],
+        "results": results,
+        "thresholds": {rule: rules_lib.resolve(rule)
+                       for rule, _ in slo_lib.SERVE_RULES},
+    }

@@ -57,43 +57,53 @@ def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
     return hashlib.sha256(blob).hexdigest()[:16]
 
 
-def cache_path(cache_dir: str, fp: str) -> str:
-    return os.path.join(cache_dir, f"tune-{fp}.json")
+def cache_path(cache_dir: str, fp: str, prefix: str = "tune") -> str:
+    return os.path.join(cache_dir, f"{prefix}-{fp}.json")
 
 
-def load(cache_dir: str, fp: str) -> Optional[Dict[str, Any]]:
+def _validate_train_tuned(tuned: Dict[str, Any]) -> bool:
+    """The train tuner's knob sanity check: the four knobs must all be
+    present and sane — an insane value (wrong type, non-positive) is a
+    MISS here, not a crash later in resolve_staging_budget_bytes."""
+    if int(tuned["k"]) < 1 or int(tuned["grad_accum_steps"]) < 1:
+        return False
+    bool(tuned["remat"])
+    budget = tuned["staging_budget_mb"]
+    if budget is not None and (isinstance(budget, bool)
+                               or not isinstance(budget, (int, float))
+                               or budget <= 0):
+        return False
+    return True
+
+
+def load(cache_dir: str, fp: str, *, prefix: str = "tune",
+         validate=_validate_train_tuned) -> Optional[Dict[str, Any]]:
     """The cached record for ``fp``, or None on miss — a corrupt,
     partial, or wrong-schema file reads as a miss (re-probe), never as
-    an error (a stale cache must not fail a run)."""
+    an error (a stale cache must not fail a run). ``prefix``/
+    ``validate`` let other tuners (the serve engine's decode-batch/
+    KV-layout search) share the one cache mechanism with their own knob
+    schema; a ``validate`` that raises or returns False is a miss."""
     try:
-        with open(cache_path(cache_dir, fp)) as f:
+        with open(cache_path(cache_dir, fp, prefix)) as f:
             rec = json.load(f)
         if rec.get("schema") != SCHEMA or rec.get("fingerprint") != fp:
             return None
-        tuned = rec["tuned"]
-        # the four knobs must all be present and sane — an insane value
-        # (wrong type, non-positive) is a MISS here, not a crash later
-        # in resolve_staging_budget_bytes
-        if int(tuned["k"]) < 1 or int(tuned["grad_accum_steps"]) < 1:
-            return None
-        bool(tuned["remat"])
-        budget = tuned["staging_budget_mb"]
-        if budget is not None and (isinstance(budget, bool)
-                                   or not isinstance(budget, (int, float))
-                                   or budget <= 0):
+        if not validate(rec["tuned"]):
             return None
         return rec
     except (OSError, ValueError, KeyError, TypeError):
         return None
 
 
-def store(cache_dir: str, fp: str, record: Dict[str, Any]) -> bool:
+def store(cache_dir: str, fp: str, record: Dict[str, Any], *,
+          prefix: str = "tune") -> bool:
     """Atomically persist ``record`` (coordinator only — callers gate).
     Best-effort: a read-only cache dir degrades to un-cached runs, not a
     failed one."""
     try:
         os.makedirs(cache_dir, exist_ok=True)
-        path = cache_path(cache_dir, fp)
+        path = cache_path(cache_dir, fp, prefix)
         tmp = f"{path}.tmp.{os.getpid()}"
         with open(tmp, "w") as f:
             json.dump({**record, "schema": SCHEMA, "fingerprint": fp,

@@ -182,6 +182,29 @@ def comm_status(exposed_frac, max_frac: float | None = None) -> str:
     return _impl(exposed_frac, max_frac)
 
 
+# Serving SLO gates (tpudist.serve): latency-percentile ceilings plus a
+# throughput floor, graded over the serve loop's measured TTFT/ITL
+# histograms. Aliased from the shared rules table like every other gate
+# (env overrides TPUDIST_TTFT_P99_MAX / TPUDIST_ITL_P99_MAX /
+# TPUDIST_TOKENS_PER_CHIP_MIN, read at call time).
+TTFT_P99_MAX = rules_lib.TTFT_P99_MAX
+ITL_P99_MAX = rules_lib.ITL_P99_MAX
+TOKENS_PER_CHIP_MIN = rules_lib.TOKENS_PER_CHIP_MIN
+
+
+def serve_status(ttft_p99_s, itl_p99_s, tokens_per_sec_per_chip) -> str:
+    """Three-valued serving-SLO verdict (tpudist.serve): the fold of the
+    ttft/itl/tokens_per_chip gates — FAIL if any gate fails, UNGATEABLE
+    when nothing was measurable (an empty request stream must not read
+    as an SLO pass). The implementation lives in tpudist.serve.slo next
+    to the percentile math that produces the inputs; this delegator
+    keeps the verdict surface in one place like the other gates. (Lazy
+    import: serve.slo mirrors this module's status vocabulary without
+    importing it — same pattern as obs.alerts.)"""
+    from tpudist.serve.slo import serve_status as _impl
+    return _impl(ttft_p99_s, itl_p99_s, tokens_per_sec_per_chip)
+
+
 def _write(path: str, content: str) -> None:
     if path.startswith("gs://"):
         # shell-free: path/content go as argv/stdin, immune to metacharacters
