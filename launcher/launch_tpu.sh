@@ -69,6 +69,13 @@
 #   SKIP_SELFCHECK=1    bypass the pre-training on-chip kernel selfcheck
 #                       (debugging a slice with a known-red kernel)
 #   SKIP_TESTS_TPU=1    bypass the on-chip pytest lane (tests_tpu/)
+#   ATTEMPTS_LOG        attempts.jsonl path (default flightrec_artifacts/
+#                       attempts.jsonl): one record per workload attempt
+#                       (index, start/end epoch-seconds, rc, requeue-
+#                       policy verdict), written on THIS host around
+#                       each invocation — the spine of the cross-attempt
+#                       goodput ledger (python -m tpudist.obs.goodput,
+#                       run here on success -> BENCH_GOODPUT.json)
 #   MAX_REQUEUES        auto-requeue budget (default 0 = off): a failed/
 #                       stalled training job is classified by
 #                       tpudist.elastic.policy (run on THIS host, jax-free)
@@ -128,6 +135,23 @@ fi
 # the requeue policy runs on THIS host (it is stdlib-only python); the
 # repo root sits one level above this script
 SCRIPT_DIR="$(cd "$(dirname "$0")" && pwd)"
+# attempts.jsonl: one record per workload invocation (attempt index,
+# start/end epoch-seconds, rc, policy verdict) — the spine of the
+# cross-attempt goodput ledger (python -m tpudist.obs.goodput). Written
+# HERE, on the launcher host: only this wrapper sees the off-pod time
+# between attempts (backoff + re-provisioning), and it lands next to
+# the collected obs artifacts so one directory feeds the ledger.
+ATTEMPTS_LOG="${ATTEMPTS_LOG:-flightrec_artifacts/attempts.jsonl}"
+# one launch = one ledger: a retry from the same cwd must not fold the
+# PREVIOUS launch's attempts into this run's goodput accounting (the
+# ledger also filters by run_id, but a clean spine beats a filtered one)
+rm -f "$ATTEMPTS_LOG" 2>/dev/null || true
+
+append_attempt() {  # append_attempt <attempt> <start> <end> <rc> <verdict>
+  mkdir -p "$(dirname "$ATTEMPTS_LOG")" 2>/dev/null || true
+  printf '{"kind":"attempt","run_id":"%s","mode":"%s","attempt":%d,"start_ts":%d,"end_ts":%d,"rc":%d,"verdict":"%s"}\n' \
+    "$RUN_ID" "$MODE" "$1" "$2" "$3" "$4" "$5" >> "$ATTEMPTS_LOG" || true
+}
 
 # shell-quote every extra workload flag: flags with spaces/metacharacters
 # must survive the ssh --command round-trip verbatim
@@ -408,12 +432,17 @@ while :; do
   # same inline-assignment path for bare runs: the run id (and, when
   # LIVE_PORT is set, the live-bus switches + coordinator endpoint)
   # reaches every worker's environment.
+  ATT_START=$(date +%s)
   set +e
   tpu_ssh all "TPUDIST_VERDICT_PATH=$OBS_DIR/job_status.txt $LIVE_ENV \
     timeout -k 60 $TIMEOUT_S $RUN_PREFIX $WORKLOAD$EXTRA_Q"
   RC=$?
   set -e
-  [ $RC -eq 0 ] && break
+  ATT_END=$(date +%s)
+  if [ $RC -eq 0 ]; then
+    append_attempt "$attempt" "$ATT_START" "$ATT_END" 0 success
+    break
+  fi
 
   if [ $RC -eq 124 ]; then
     echo "❌ distributed TPU job TIMED OUT after ${TIMEOUT_S}s (hang — " \
@@ -440,6 +469,12 @@ while :; do
   POLICY_RC=$?
   set -e
   echo "requeue policy: ${DECISION:-<policy unavailable>}"
+  # the attempt's ledger record carries the policy's classification —
+  # the goodput CLI later explains each attempt's wall by this verdict
+  ATT_VERDICT=$(printf '%s\n' "$DECISION" \
+    | sed -n 's/.*VERDICT=\([a-z_]*\).*/\1/p')
+  append_attempt "$attempt" "$ATT_START" "$ATT_END" "$RC" \
+    "${ATT_VERDICT:-unknown}"
   if [ "$POLICY_RC" -eq 0 ]; then
     BACKOFF=$(printf '%s\n' "$DECISION" \
       | sed -n 's/.*BACKOFF_S=\([0-9.]*\).*/\1/p')
@@ -485,9 +520,20 @@ gcloud compute tpus tpu-vm scp \
   "$TPU_NAME:$OBS_DIR/pod_trace.json" \
   "$TPU_NAME:$OBS_DIR/run_report.json" \
   "$TPU_NAME:$OBS_DIR/run_report.md" \
+  "$TPU_NAME:$METRICS_PATH" \
   $SERVE_PULL \
   flightrec_artifacts/ --zone "$ZONE" --project "$PROJECT" \
   --worker=0 2>/dev/null || true
+# cross-attempt goodput ledger on THIS host (the CLI is jax-free, like
+# the policy): attempts.jsonl written above around every invocation +
+# the pulled metrics.jsonl + the per-attempt beacon snapshots the
+# failure path collected. Best-effort: a missing ledger must not
+# repaint a green run red.
+if [ -s "$ATTEMPTS_LOG" ]; then
+  PYTHONPATH="$SCRIPT_DIR/..${PYTHONPATH:+:$PYTHONPATH}" \
+    python3 -m tpudist.obs.goodput --run-dir flightrec_artifacts \
+    --bench-out flightrec_artifacts/BENCH_GOODPUT.json || true
+fi
 # --profile-window device captures (raw jax.profiler trace-event JSON
 # under $OBS_DIR/profile/worker<i>): pull the coordinator's so the
 # devtime split can be re-derived offline (tpudist.obs.devtime is

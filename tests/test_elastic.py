@@ -431,6 +431,73 @@ def test_resume_status_verdict():
     assert verdict.resume_status(True, False, error=True) == verdict.FAIL
 
 
+def test_beacon_namespaced_by_requeue_attempt(tmp_path):
+    """Attempt N's flight recorder must never let attempt N-1's beacon
+    read as its own progress: a stale beacon in a shared obs dir is
+    archived to heartbeat.worker<i>.attempt<K> (K from the STALE
+    payload's own stamp) before the first write, and the fresh beacon
+    carries the new attempt — the goodput ledger reads the archive for
+    lost-step math, the launcher's per-attempt classification reads
+    only current-attempt beacons."""
+    from tpudist.obs.heartbeat import FlightRecorder
+
+    # attempt 0 beats and dies (no close — a preemption)
+    r0 = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                        process_index=0, requeue_attempt=0)
+    r0.note_progress(phase="train", epoch=0, step=5)
+    r0.beacon_now()
+    r0._stop.set()                     # thread down, beacon left behind
+    with open(r0.beacon_path) as f:
+        assert json.load(f)["requeue_attempt"] == 0
+
+    # attempt 1 starts in the same dir: the stale beacon is archived,
+    # its progress counters intact, and the live beacon is attempt 1's
+    r1 = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                        process_index=0, requeue_attempt=1)
+    archived = os.path.join(str(tmp_path), "heartbeat.worker0.attempt0")
+    assert os.path.exists(archived), os.listdir(str(tmp_path))
+    with open(archived) as f:
+        old = json.load(f)
+    assert old["step"] == 5 and old["requeue_attempt"] == 0
+    r1.note_progress(phase="train", epoch=0, step=3)
+    r1.beacon_now()
+    with open(r1.beacon_path) as f:
+        fresh = json.load(f)
+    assert fresh["requeue_attempt"] == 1 and fresh["step"] == 3
+    r1.close()
+    # same attempt restarting in place does NOT archive (overwrite wins)
+    r1b = FlightRecorder(str(tmp_path), stall_timeout_s=0,
+                         process_index=0, requeue_attempt=1)
+    assert not os.path.exists(r1.beacon_path + ".attempt1")
+    r1b.close()
+
+
+def test_policy_vanished_workers_scoped_to_attempt(tmp_path):
+    """A worker that never STARTED in attempt 1 leaves only its
+    attempt-0 beacon behind; scoping the vanished-worker inference to
+    the attempt under classification must ignore it — while beacons
+    too old to carry the stamp keep the pre-namespacing behavior."""
+    d = tmp_path / "artifacts"
+    d.mkdir()
+    (d / "heartbeat.worker0").write_text(
+        json.dumps({"step": 4, "requeue_attempt": 1}))
+    (d / "heartbeat.worker1").write_text(
+        json.dumps({"step": 9, "requeue_attempt": 0}))   # stale
+    # archived beacons are never evidence for ANY attempt
+    (d / "heartbeat.worker1.attempt0").write_text(
+        json.dumps({"step": 9, "requeue_attempt": 0}))
+    assert policy.vanished_workers(str(d), attempt=1) == [0]
+    # unscoped keeps the old behavior: both plain beacons count
+    assert policy.vanished_workers(str(d)) == [0, 1]
+    # an unstamped (old-format) beacon still counts under scoping
+    (d / "heartbeat.worker2").write_text(json.dumps({"step": 1}))
+    assert policy.vanished_workers(str(d), attempt=1) == [0, 2]
+    # and decide() threads its attempt through to the classification
+    dec = policy.decide(1, attempt=1, max_requeues=3,
+                        flightrec_dir=str(d))
+    assert dec.verdict == policy.PREEMPTION and dec.requeue
+
+
 # --------------------------------------------------- preemption drills
 
 

@@ -1,0 +1,553 @@
+"""Goodput ledger (tpudist.obs.goodput): cross-attempt wall-clock
+accounting. The scripted tests pin the bucket math and the exactness
+invariant against hand-built artifact sets; the consumer-parity tests
+pin that the CLI, the schema-5 report section, and the Prometheus
+gauges all report the IDENTICAL goodput fraction; the drill test runs
+the real train CLI through a scripted kill -> policy requeue -> resume
+and asserts the acceptance contract (partition exact within the pinned
+1% tolerance, lost steps == dead beacon step - resumed step).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tpudist import rules as rules_lib
+from tpudist import verdict as verdict_lib
+from tpudist.obs import goodput as gp
+from tpudist.obs import report as report_lib
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------- the gate
+
+
+def test_goodput_status_three_valued(monkeypatch):
+    assert gp.goodput_status(None) == gp.UNGATEABLE
+    assert gp.goodput_status(0.9) == gp.SUCCESS
+    assert gp.goodput_status(0.1) == gp.FAIL
+    assert gp.goodput_status(rules_lib.GOODPUT_MIN) == gp.SUCCESS
+    # env override read at CALL time, like every other gate
+    monkeypatch.setenv("TPUDIST_GOODPUT_MIN", "0.05")
+    assert gp.goodput_status(0.1) == gp.SUCCESS
+    # explicit floor wins
+    assert gp.goodput_status(0.1, 0.2) == gp.FAIL
+
+
+def test_exit_grader_shares_the_rules_constant():
+    """The shared-rules pin, extended to the goodput gate: one constant,
+    three aliases — the graders cannot drift."""
+    assert gp.GOODPUT_MIN is rules_lib.GOODPUT_MIN
+    assert verdict_lib.GOODPUT_MIN is rules_lib.GOODPUT_MIN
+    assert rules_lib.get("goodput").sense == "min"
+    assert rules_lib.get("goodput").alert is True
+    # the verdict delegator and the impl agree on the same env knob
+    assert verdict_lib.goodput_status(0.4) == gp.goodput_status(0.4)
+
+
+# ------------------------------------------------- scripted ledgers
+
+
+def scripted_inputs():
+    """A hand-built 2-attempt run with exactly-known numbers: attempt 0
+    killed at step 5 (ckpt committed at 3, sps 2.0), attempt 1 resumes
+    at 3 and completes. Every bucket below is hand-derivable."""
+    attempts = [
+        {"attempt": 0, "start_ts": 1000.0, "end_ts": 1010.0, "rc": 113,
+         "verdict": "preemption", "run_id": "r1"},
+        {"attempt": 1, "start_ts": 1012.0, "end_ts": 1030.0, "rc": 0,
+         "verdict": "success"},
+    ]
+    records = [
+        {"kind": "attempt", "requeue_attempt": 0, "ts": 1002.0},
+        {"kind": "step", "requeue_attempt": 0, "ts": 1004.0, "epoch": 0,
+         "step": 2, "steps_per_sec": 2.0},
+        {"kind": "ckpt", "requeue_attempt": 0, "ts": 1005.0, "epoch": 0,
+         "step": 3, "step_in_epoch": 3, "enqueue_ms": 100.0},
+        {"kind": "attempt", "requeue_attempt": 1, "ts": 1014.0},
+        {"kind": "resume", "requeue_attempt": 1, "ts": 1015.0,
+         "status": "success", "epoch": 0, "step_in_epoch": 3,
+         "resumed_from_step": 3, "steps_lost": 2},
+        {"kind": "epoch", "requeue_attempt": 1, "ts": 1020.0,
+         "epoch": 0, "eval_s": 0.5, "steps_per_sec": 2.5},
+        {"kind": "ckpt", "requeue_attempt": 1, "ts": 1020.5, "epoch": 0,
+         "step": 8, "step_in_epoch": 0, "enqueue_ms": 200.0},
+        {"kind": "ckpt_drain", "requeue_attempt": 1, "ts": 1021.0,
+         "drain_ms": 300.0},
+        {"kind": "timing", "requeue_attempt": 1, "ts": 1021.0,
+         "compile_warmup_s": 1.5, "run_s": 2.0, "stage_wait_s": 0.25,
+         "steps": 5},
+    ]
+    beacons = {0: {0: {"step": 5, "epoch": 0, "requeue_attempt": 0}}}
+    return attempts, records, beacons
+
+
+def test_ledger_partition_exact_and_buckets():
+    attempts, records, beacons = scripted_inputs()
+    led = gp.build_ledger(attempts, records, beacons=beacons)
+    # THE invariant: every bucket summed equals the total wall (here
+    # to float rounding, far inside the pinned 1%)
+    assert abs(sum(led["totals"].values()) - led["total_wall_s"]) < 1e-6
+    assert led["exact"] is True and led["problems"] == []
+    assert led["total_wall_s"] == 30.0
+    assert led["run_id"] == "r1"
+    a0, a1 = led["attempts"]
+    # dead attempt: beacon says 5, committed 3 -> 2 lost, both sources
+    assert a0["lost_steps"] == 2 and a0["lost_steps_beacon"] == 2
+    assert a0["steps_done"] == 5 and a0["beacon_step"] == 5
+    b0 = a0["buckets"]
+    assert b0["startup"] == pytest.approx(2.0)     # 1002 - 1000
+    assert b0["lost"] == pytest.approx(1.0)        # 2 steps / 2 sps
+    assert b0["productive"] == pytest.approx(1.5)  # 3 kept / 2 sps
+    # compile estimate: first-step gap (1004-1002) minus 2 steps worth
+    assert b0["compile"] == pytest.approx(1.0)
+    assert b0["ckpt"] == pytest.approx(0.1)
+    assert b0["residue"] == pytest.approx(10.0 - 2.0 - 1.0 - 1.5 - 1.0
+                                          - 0.1)
+    # completed requeued attempt: warmup reads as REwarmup
+    b1 = a1["buckets"]
+    assert b1["rewarmup"] == pytest.approx(1.5) and b1["compile"] == 0.0
+    assert b1["productive"] == pytest.approx(1.75)  # run 2.0 - wait .25
+    assert b1["staging_exposed"] == pytest.approx(0.25)
+    assert b1["ckpt"] == pytest.approx(0.5)        # 200ms + 300ms drain
+    assert b1["eval"] == pytest.approx(0.5)
+    # the gap between attempts is off-pod time
+    assert led["totals"]["off_pod"] == pytest.approx(2.0)
+    assert led["lost_steps"] == 2
+    assert led["goodput_fraction"] == pytest.approx(3.25 / 30.0,
+                                                    abs=1e-6)
+    assert led["goodput_status"] == gp.goodput_status(
+        led["goodput_fraction"])
+
+
+def test_ledger_flags_double_counting_inexact():
+    """Measured buckets exceeding an attempt's wall is double counting:
+    residue goes negative past the tolerance and the ledger says so
+    instead of quietly reporting a pretty partition."""
+    attempts = [{"attempt": 0, "start_ts": 0.0, "end_ts": 5.0, "rc": 0,
+                 "verdict": "success"}]
+    records = [{"kind": "timing", "requeue_attempt": 0, "ts": 1.0,
+                "compile_warmup_s": 2.0, "run_s": 9.0,
+                "stage_wait_s": 0.0, "steps": 9}]
+    led = gp.build_ledger(attempts, records)
+    assert led["exact"] is False
+    assert any("double counting" in p for p in led["problems"])
+    # the sum STILL equals the total (residue is negative): exactness
+    # is about honesty, not about forcing the numbers
+    assert abs(sum(led["totals"].values()) - led["total_wall_s"]) < 1e-6
+
+
+def test_ledger_flags_overlapping_attempts():
+    attempts = [
+        {"attempt": 0, "start_ts": 0.0, "end_ts": 10.0, "rc": 113,
+         "verdict": "preemption"},
+        {"attempt": 1, "start_ts": 8.0, "end_ts": 20.0, "rc": 0,
+         "verdict": "success"},
+    ]
+    led = gp.build_ledger(attempts, [])
+    assert led["exact"] is False
+    assert any("overlaps" in p for p in led["problems"])
+
+
+def test_ledger_dead_attempt_without_resume_loses_everything():
+    """A killed attempt never followed by a successful restore threw
+    ALL its computed steps away — the next attempt started fresh."""
+    attempts = [
+        {"attempt": 0, "start_ts": 0.0, "end_ts": 10.0, "rc": 137,
+         "verdict": "preemption"},
+        {"attempt": 1, "start_ts": 10.0, "end_ts": 20.0, "rc": 1,
+         "verdict": "crash"},
+    ]
+    records = [
+        {"kind": "step", "requeue_attempt": 0, "ts": 2.0, "epoch": 0,
+         "step": 4, "steps_per_sec": 2.0},
+        {"kind": "resume", "requeue_attempt": 1, "ts": 11.0,
+         "status": "fail", "epoch": 0, "step_in_epoch": 0,
+         "resumed_from_step": 0},
+    ]
+    led = gp.build_ledger(attempts, records)
+    a0 = led["attempts"][0]
+    assert a0["steps_done"] == 4 and a0["lost_steps"] == 4
+    assert a0["buckets"]["lost"] == pytest.approx(2.0)   # 4 / 2 sps
+    assert a0["buckets"]["productive"] == 0.0
+
+
+def test_ledger_requires_attempts():
+    with pytest.raises(ValueError, match="attempts.jsonl"):
+        gp.build_ledger([], [])
+    assert gp.build_from_dir("/nonexistent/dir") is None
+
+
+def test_find_beacons_plain_archived_and_nested(tmp_path):
+    """Beacon discovery spans generations and layouts: the plain
+    current beacon, the per-attempt archives the flight recorder
+    leaves, per-attempt collection subdirs — keyed by the PAYLOAD's
+    attempt stamp, torn files skipped, .tmp leftovers ignored."""
+    (tmp_path / "heartbeat.worker0").write_text(
+        json.dumps({"step": 8, "requeue_attempt": 1}))
+    (tmp_path / "heartbeat.worker0.attempt0").write_text(
+        json.dumps({"step": 5, "requeue_attempt": 0}))
+    sub = tmp_path / "attempt0"
+    sub.mkdir()
+    (sub / "heartbeat.worker1").write_text(
+        json.dumps({"step": 4, "requeue_attempt": 0}))
+    (tmp_path / "heartbeat.worker2.tmp").write_text("{}")
+    (tmp_path / "heartbeat.worker3").write_text("{torn")
+    out = gp.find_beacons(str(tmp_path))
+    assert out[1][0]["step"] == 8
+    assert out[0][0]["step"] == 5
+    assert out[0][1]["step"] == 4
+    assert 2 not in out[0] and 3 not in out[0]
+
+
+def test_ledger_filters_out_other_launches_evidence():
+    """A retry from the same artifacts directory must account ONLY the
+    newest launch: stamped attempts/records/beacons from an earlier
+    run_id are another launch's leftovers, while unstamped evidence
+    (scripted/old artifacts) stays."""
+    attempts = [
+        {"attempt": 0, "start_ts": 0.0, "end_ts": 10.0, "rc": 1,
+         "verdict": "crash", "run_id": "old-run"},
+        {"attempt": 0, "start_ts": 100.0, "end_ts": 110.0, "rc": 0,
+         "verdict": "success", "run_id": "new-run"},
+    ]
+    records = [
+        {"kind": "ckpt", "requeue_attempt": 0, "ts": 2.0,
+         "enqueue_ms": 5000.0, "run_id": "old-run"},
+        {"kind": "timing", "requeue_attempt": 0, "ts": 105.0,
+         "compile_warmup_s": 1.0, "run_s": 4.0, "steps": 8,
+         "run_id": "new-run"},
+    ]
+    beacons = {0: {0: {"step": 9, "epoch": 0, "run_id": "old-run"}}}
+    led = gp.build_ledger(attempts, records, beacons=beacons)
+    assert led["run_id"] == "new-run"
+    assert len(led["attempts"]) == 1
+    assert led["total_wall_s"] == 10.0          # NOT anchored at t=0
+    assert led["attempts"][0]["buckets"]["ckpt"] == 0.0   # old record out
+    assert led["attempts"][0]["buckets"]["productive"] == \
+        pytest.approx(4.0)
+    assert led["exact"] is True, led["problems"]
+
+
+def test_beacon_progress_orders_by_epoch_then_step():
+    """Step resets every epoch: a straggler's epoch-0/step-7 beacon
+    must not outrank a peer's epoch-1/step-2 — both in the per-attempt
+    pick and in find_beacons' duplicate dedup."""
+    step, epoch = gp._beacon_progress(
+        {0: {"step": 7, "epoch": 0}, 1: {"step": 2, "epoch": 1}})
+    assert (step, epoch) == (2, 1)
+    assert gp._progress_key({"step": 7, "epoch": 0}) \
+        < gp._progress_key({"step": 2, "epoch": 1})
+
+
+def test_find_beacons_dedup_prefers_later_epoch(tmp_path):
+    (tmp_path / "heartbeat.worker0").write_text(
+        json.dumps({"step": 2, "epoch": 1, "requeue_attempt": 0}))
+    sub = tmp_path / "attempt0"
+    sub.mkdir()
+    (sub / "heartbeat.worker0").write_text(
+        json.dumps({"step": 7, "epoch": 0, "requeue_attempt": 0}))
+    out = gp.find_beacons(str(tmp_path))
+    assert out[0][0]["epoch"] == 1 and out[0][0]["step"] == 2
+
+
+def test_report_trace_schema_mirror_matches_the_real_constant():
+    """report.py cannot import obs.trace (it imports jax, the report is
+    jax-free) so it mirrors TRACE_SCHEMA_VERSION as a literal — this
+    diff keeps the mirror honest when the trace schema bumps."""
+    from tpudist.obs import trace as trace_mod
+    assert report_lib.KNOWN_ARTIFACT_SCHEMAS["trace"] \
+        == trace_mod.TRACE_SCHEMA_VERSION
+    from tpudist.obs import live as live_mod
+    assert report_lib.KNOWN_ARTIFACT_SCHEMAS["alerts"] \
+        is live_mod.LIVE_SCHEMA_VERSION
+    assert report_lib.KNOWN_ARTIFACT_SCHEMAS["goodput"] \
+        is gp.GOODPUT_SCHEMA_VERSION
+
+
+def test_attempt_record_matches_completed_bucket_math():
+    """The train loop's run-end kind=goodput record applies the SAME
+    completed-attempt math the ledger does."""
+    history = [
+        {"kind": "ckpt", "enqueue_ms": 100.0},
+        {"kind": "ckpt_drain", "drain_ms": 400.0},
+        {"kind": "epoch", "eval_s": 0.5},
+        {"kind": "timing", "compile_warmup_s": 1.0, "run_s": 6.0,
+         "stage_wait_s": 1.0, "steps": 12},
+    ]
+    rec = gp.attempt_record(history, wall_s=10.0, requeue_attempt=0)
+    assert rec["productive_s"] == pytest.approx(5.0)
+    assert rec["compile_s"] == pytest.approx(1.0)
+    assert rec["staging_exposed_s"] == pytest.approx(1.0)
+    assert rec["ckpt_s"] == pytest.approx(0.5)
+    assert rec["eval_s"] == pytest.approx(0.5)
+    assert rec["fraction"] == pytest.approx(0.5)
+    assert rec["status"] == gp.goodput_status(0.5)
+    # a requeued attempt's warmup is REwarmup
+    rec1 = gp.attempt_record(history, wall_s=10.0, requeue_attempt=1)
+    assert rec1["rewarmup_s"] == pytest.approx(1.0)
+    assert "compile_s" not in rec1
+    # nothing measured -> no record (a non-coordinator, a crashed run)
+    assert gp.attempt_record([], wall_s=10.0) is None
+
+
+# ------------------------------------------------ prometheus + bench
+
+
+GOLDEN_LEDGER = {
+    "schema": 1, "run_id": "r1",
+    "attempts": [{"attempt": 0}, {"attempt": 1}],
+    "totals": {"productive": 3.25, "compile": 1.0, "rewarmup": 1.5,
+               "staging_exposed": 0.25, "ckpt": 0.6, "eval": 0.5,
+               "lost": 1.0, "startup": 4.0, "off_pod": 2.0,
+               "residue": 15.9},
+    "total_wall_s": 30.0, "goodput_fraction": 0.108333,
+    "goodput_status": "fail", "lost_steps": 2, "exact": True,
+}
+
+GOLDEN_PROM = """\
+# HELP tpudist_goodput_info Ledger identity (labels carry run_id and \
+attempt count).
+# TYPE tpudist_goodput_info gauge
+tpudist_goodput_info{run_id="r1",attempts="2"} 1
+# HELP tpudist_goodput_fraction Productive training fraction of the \
+cross-attempt wall clock.
+# TYPE tpudist_goodput_fraction gauge
+tpudist_goodput_fraction 0.108333
+# HELP tpudist_goodput_total_wall_seconds Total wall from first \
+attempt start to last attempt end.
+# TYPE tpudist_goodput_total_wall_seconds gauge
+tpudist_goodput_total_wall_seconds 30
+# HELP tpudist_goodput_bucket_seconds Wall seconds per badput bucket \
+(the partition sums to total).
+# TYPE tpudist_goodput_bucket_seconds gauge
+tpudist_goodput_bucket_seconds{bucket="productive"} 3.25
+tpudist_goodput_bucket_seconds{bucket="compile"} 1
+tpudist_goodput_bucket_seconds{bucket="rewarmup"} 1.5
+tpudist_goodput_bucket_seconds{bucket="staging_exposed"} 0.25
+tpudist_goodput_bucket_seconds{bucket="ckpt"} 0.6
+tpudist_goodput_bucket_seconds{bucket="eval"} 0.5
+tpudist_goodput_bucket_seconds{bucket="lost"} 1
+tpudist_goodput_bucket_seconds{bucket="startup"} 4
+tpudist_goodput_bucket_seconds{bucket="off_pod"} 2
+tpudist_goodput_bucket_seconds{bucket="residue"} 15.9
+# HELP tpudist_goodput_lost_steps Steps recomputed after preemption \
+kills (beacon vs resume point).
+# TYPE tpudist_goodput_lost_steps gauge
+tpudist_goodput_lost_steps 2
+# HELP tpudist_goodput_exact 1 when the partition met the pinned \
+tolerance.
+# TYPE tpudist_goodput_exact gauge
+tpudist_goodput_exact 1
+"""
+
+
+def test_prometheus_text_golden():
+    assert gp.prometheus_text(GOLDEN_LEDGER) == GOLDEN_PROM
+
+
+def test_bench_artifact_shape():
+    art = gp.bench_artifact(GOLDEN_LEDGER)
+    assert art["metric"] == "goodput_fraction"
+    assert art["value"] == GOLDEN_LEDGER["goodput_fraction"]
+    assert art["detail"] is GOLDEN_LEDGER
+
+
+# -------------------------------------------------- consumer parity
+
+
+def write_scripted_dir(tmp_path):
+    attempts, records, beacons = scripted_inputs()
+    with open(tmp_path / "attempts.jsonl", "w") as f:
+        for a in attempts:
+            f.write(json.dumps(a) + "\n")
+    with open(tmp_path / "metrics.jsonl", "w") as f:
+        for r in records:
+            f.write(json.dumps(r) + "\n")
+    (tmp_path / "heartbeat.worker0.attempt0").write_text(
+        json.dumps(beacons[0][0]))
+    (tmp_path / "trace.worker0.json").write_text(
+        json.dumps({"traceEvents": []}))
+    return attempts, records
+
+
+def test_cli_report_and_prometheus_agree_on_the_fraction(tmp_path,
+                                                         capsys):
+    """THE consumer-parity pin (same pattern as the rules-table parity
+    diff): the CLI's ledger, the schema-5 report's Goodput section, and
+    the Prometheus gauge must carry the IDENTICAL goodput fraction."""
+    write_scripted_dir(tmp_path)
+    rc = gp.main(["--run-dir", str(tmp_path),
+                  "--bench-out", str(tmp_path / "BENCH_GOODPUT.json"),
+                  "--prom-out", str(tmp_path / "goodput.prom")])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "tpudist: goodput" in out and "partition exact" in out
+    led = json.load(open(tmp_path / "goodput.json"))
+    frac = led["goodput_fraction"]
+    assert f"{100 * frac:.1f}% productive" in out
+    # the report CLI discovers goodput.json in the run dir
+    rc = report_lib.main(["--run-dir", str(tmp_path)])
+    assert rc == 0
+    rep = json.load(open(tmp_path / "run_report.json"))
+    assert rep["schema"] == report_lib.REPORT_SCHEMA_VERSION
+    sec = rep["goodput"]
+    assert sec["enabled"] and sec["cross_attempt"]
+    assert sec["fraction"] == frac
+    assert sec["lost_steps"] == led["lost_steps"] == 2
+    assert [a["attempt"] for a in sec["attempts"]] == [0, 1]
+    # the Prometheus gauge renders the identical number
+    prom = open(tmp_path / "goodput.prom").read()
+    line = [ln for ln in prom.splitlines()
+            if ln.startswith("tpudist_goodput_fraction ")][0]
+    assert float(line.split()[-1]) == frac
+    bench = json.load(open(tmp_path / "BENCH_GOODPUT.json"))
+    assert bench["value"] == frac
+    md = open(tmp_path / "run_report.md").read()
+    assert "## Goodput" in md and "step(s) lost" in md
+
+
+def test_report_builds_ledger_from_attempts_jsonl(tmp_path):
+    """Without a prebuilt goodput.json the report CLI builds the ledger
+    itself from a discovered attempts.jsonl — attempts fold in with no
+    extra tooling pass."""
+    write_scripted_dir(tmp_path)
+    rc = report_lib.main(["--run-dir", str(tmp_path)])
+    assert rc == 0
+    rep = json.load(open(tmp_path / "run_report.json"))
+    assert rep["goodput"]["enabled"] and rep["goodput"]["cross_attempt"]
+    assert rep["goodput"]["exact"] is True
+
+
+def test_report_single_attempt_falls_back_to_goodput_record():
+    """Runs that never requeued (no attempts.jsonl) still get a Goodput
+    section from the run-end kind=goodput record."""
+    metrics = [{"kind": "goodput", "fraction": 0.42, "status": "fail",
+                "wall_s": 10.0, "requeue_attempt": 0,
+                "productive_s": 4.2, "compile_s": 1.0}]
+    rep = report_lib.build_report(metrics, {"traceEvents": []})
+    sec = rep["goodput"]
+    assert sec["enabled"] and not sec["cross_attempt"]
+    assert sec["fraction"] == 0.42
+    assert sec["buckets"]["productive"] == 4.2
+    # re-graded through the rules table at fold time
+    assert sec["status"] == gp.goodput_status(0.42)
+    # and no goodput evidence at all reads disabled, not zero
+    assert report_lib.build_report([], {"traceEvents": []})["goodput"] \
+        == {"enabled": False}
+
+
+# ----------------------------------------------- schema forward-compat
+
+
+def test_report_accepts_newer_trace_schema_with_warning(capsys):
+    """The forward-compat satellite: artifacts stamped with a NEWER
+    schema than this reader knows warn and fold, never fail — a requeue
+    loop can scatter attempts across tpudist versions."""
+    doc = {"traceEvents": [], "metadata": {"schema": 99}}
+    assert report_lib.warn_newer_schema(doc, "trace") is True
+    err = capsys.readouterr().err
+    assert "schema 99" in err and "one report" in err
+    rep = report_lib.build_report([], doc)
+    assert rep["verdict"] == report_lib.UNGATEABLE
+    # same-or-older schemas stay silent
+    assert report_lib.warn_newer_schema(
+        {"metadata": {"schema": 1}}, "trace") is False
+    assert capsys.readouterr().err == ""
+
+
+def test_report_cli_newer_artifacts_still_fold(tmp_path, capsys):
+    write_scripted_dir(tmp_path)
+    # overwrite every schema-stamped artifact with a future version
+    (tmp_path / "trace.worker0.json").write_text(json.dumps(
+        {"traceEvents": [], "metadata": {"schema": 7}}))
+    (tmp_path / "live_status.json").write_text(json.dumps(
+        {"schema": 9, "alerts": {"history": []}}))
+    led = gp.build_from_dir(str(tmp_path))
+    led["schema"] = 12
+    (tmp_path / "goodput.json").write_text(json.dumps(led))
+    rc = report_lib.main(["--run-dir", str(tmp_path)])
+    err = capsys.readouterr().err
+    assert rc == 0
+    for what in ("trace", "alerts", "goodput"):
+        assert f"{what} artifact carries schema" in err, err
+    rep = json.load(open(tmp_path / "run_report.json"))
+    assert rep["goodput"]["enabled"], "newer ledger must still fold"
+
+
+def test_goodput_cli_is_jax_free(tmp_path):
+    """The offline-tooling contract (shared with obs.report): the
+    ledger CLI runs with jax import-blocked — a CI host / laptop with
+    nothing but the stdlib + numpy against scp'd artifacts."""
+    write_scripted_dir(tmp_path)
+    code = ("import sys; sys.modules['jax'] = None; "
+            "from tpudist.obs import goodput; "
+            f"rc = goodput.main(['--run-dir', {str(tmp_path)!r}]); "
+            "assert rc == 0, rc; print('ok')")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr
+
+
+# ------------------------------------------------------ the drill
+
+
+def test_drill_kill_requeue_resume_accounts_the_wall(tmp_path,
+                                                     monkeypatch):
+    """THE acceptance drill: a real train run dies to a scripted
+    preemption at step 5 (manifest committed at 3), the requeue policy
+    classifies it, the resumed run completes — and the ledger must (a)
+    partition the whole wall exactly within the pinned 1% tolerance,
+    (b) count exactly 2 lost steps AGREEING with the independent
+    dead-beacon-vs-resume-point recomputation, (c) report the identical
+    fraction through the CLI ledger, the report section, and the
+    Prometheus gauge."""
+    # the drill's seconds-long attempts are startup-dominated by
+    # construction; the lane pins the wiring, not import latency
+    monkeypatch.setenv("TPUDIST_GOODPUT_MIN", "0.001")
+    run_dir = str(tmp_path / "drill")
+    rc = gp.main(["--drill", "--run-dir", run_dir,
+                  "--bench-out", os.path.join(run_dir,
+                                              "BENCH_GOODPUT.json"),
+                  "--prom-out", os.path.join(run_dir, "goodput.prom")])
+    assert rc == 0
+    led = json.load(open(os.path.join(run_dir, "goodput.json")))
+    # (a) exactness
+    assert led["exact"] is True, led["problems"]
+    assert abs(sum(led["totals"].values()) - led["total_wall_s"]) \
+        <= led["tolerance"] * led["total_wall_s"]
+    # (b) lost-step accounting, both sources agreeing
+    a0, a1 = led["attempts"]
+    assert a0["verdict"] in ("preemption", "stall") and a0["rc"] == 113
+    assert a0["lost_steps"] == 2, a0
+    assert a0["lost_steps"] == a0["lost_steps_beacon"], a0
+    assert a0["beacon_step"] == 5 and a0["steps_done"] == 5
+    assert led["lost_steps"] == 2 and led["totals"]["lost"] > 0
+    # the dead attempt's beacon survived under its attempt namespace
+    assert os.path.exists(os.path.join(run_dir,
+                                       "heartbeat.worker0.attempt0"))
+    # requeue costs show up as their own buckets
+    assert led["totals"]["off_pod"] >= 0.2        # the policy backoff
+    assert a1["buckets"]["rewarmup"] > 0          # re-compile after resume
+    assert a1["verdict"] == "success" and a1["rc"] == 0
+    assert led["goodput_fraction"] > 0
+    assert led["goodput_status"] == "success"     # vs the pinned floor
+    # (c) consumer parity
+    assert report_lib.main(["--run-dir", run_dir]) == 0
+    rep = json.load(open(os.path.join(run_dir, "run_report.json")))
+    assert rep["goodput"]["fraction"] == led["goodput_fraction"]
+    assert rep["goodput"]["status"] == "success"
+    prom = open(os.path.join(run_dir, "goodput.prom")).read()
+    line = [ln for ln in prom.splitlines()
+            if ln.startswith("tpudist_goodput_fraction ")][0]
+    assert float(line.split()[-1]) == led["goodput_fraction"]
+    # the run-end attempt-local records flowed into the metrics stream
+    recs = [json.loads(ln) for ln in
+            open(os.path.join(run_dir, "metrics.jsonl"))]
+    gps = [r for r in recs if r.get("kind") == "goodput"]
+    assert gps and gps[-1]["requeue_attempt"] == 1
+    assert all(r.get("run_id") == gp.DRILL_RUN_ID for r in recs)

@@ -404,6 +404,49 @@ def test_requeue_budget_exhausted_fails(stub_env):
     assert len(_train_lines(stub)) == 2          # initial + 1 requeue
 
 
+def test_attempts_jsonl_written_around_every_invocation(stub_env):
+    """The goodput ledger's spine: one attempts.jsonl record per
+    workload attempt (index, start/end epoch-seconds, rc, the requeue
+    policy's verdict), written on the LAUNCHER host — only it can see
+    the off-pod time between attempts. A preemption-then-success drill
+    must leave two records, and the success path must hand the
+    directory to the jax-free goodput CLI."""
+    import json as json_mod
+    env, stub = stub_env
+    env.update(MAX_REQUEUES="2", REQUEUE_BACKOFF_S="0",
+               STUB_TRAIN_FAIL_N="1", STUB_TRAIN_RC="137",
+               RUN_ID="r-gp-1")
+    r = launch(env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    log = stub / "flightrec_artifacts" / "attempts.jsonl"
+    assert log.exists(), "launcher must write the attempt ledger"
+    recs = [json_mod.loads(ln) for ln in log.read_text().splitlines()]
+    assert [a["attempt"] for a in recs] == [0, 1]
+    assert recs[0]["rc"] == 137 and recs[0]["verdict"] == "preemption"
+    assert recs[1]["rc"] == 0 and recs[1]["verdict"] == "success"
+    for a in recs:
+        assert a["run_id"] == "r-gp-1" and a["mode"] == "train"
+        assert a["end_ts"] >= a["start_ts"]
+    # the success path runs the cross-attempt ledger over the collected
+    # artifacts (best-effort; the CLI itself is jax-free and real even
+    # under the gcloud stubs)
+    assert "tpudist: goodput" in r.stdout, r.stdout
+    assert (stub / "flightrec_artifacts" / "goodput.json").exists()
+
+
+def test_attempts_jsonl_single_success_record(stub_env):
+    """A clean first-try run still writes its one attempt record — the
+    ledger must account single-attempt runs too."""
+    import json as json_mod
+    env, stub = stub_env
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    recs = [json_mod.loads(ln) for ln in
+            (stub / "flightrec_artifacts" / "attempts.jsonl")
+            .read_text().splitlines()]
+    assert len(recs) == 1 and recs[0]["verdict"] == "success"
+
+
 def test_no_requeue_by_default(stub_env):
     """MAX_REQUEUES defaults to 0: a signal death fails immediately
     (the pre-elastic contract holds unless the operator opts in)."""

@@ -57,7 +57,8 @@ def make_agg(tmp_path, **kw):
 def test_rules_table_names_and_alert_subset():
     names = {t.name for t in rules_lib.THRESHOLDS}
     assert names == {"straggler", "staging", "comm", "regress", "stall",
-                     "trace_drop", "ttft", "itl", "tokens_per_chip"}
+                     "trace_drop", "ttft", "itl", "tokens_per_chip",
+                     "goodput"}
     # every rule but the artifact-quality one is a live alert
     assert {t.name for t in rules_lib.ALERT_RULES} == names - {
         "trace_drop"}
@@ -466,6 +467,9 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     agg.ingest({"kind": "serve_tick", "ttft_p99_s": ttft,
                 "itl_p99_s": itl, "tokens_per_sec_per_chip": tps_chip},
                now=clk.t)
+    # a run-end goodput estimate under the floor (obs.goodput)
+    goodput_frac = 0.1
+    agg.ingest({"kind": "goodput", "fraction": goodput_frac}, now=clk.t)
     fired = {a["alert"] for a in agg.engine.firing()}
     assert fired == {t.name for t in rules_lib.ALERT_RULES}, fired
 
@@ -481,6 +485,8 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     assert stall > 5.0               # the watchdog's own dump condition
     assert verdict_lib.serve_status(ttft, itl, tps_chip) \
         == verdict_lib.FAIL
+    assert verdict_lib.goodput_status(goodput_frac) == verdict_lib.FAIL
+    assert agg.snapshot()["pod"]["goodput_fraction"] == goodput_frac
     agg.close()
 
 
@@ -504,6 +510,10 @@ tpudist_up 1
 # HELP tpudist_info Run identity (labels carry run_id and attempt).
 # TYPE tpudist_info gauge
 tpudist_info{run_id="r1",requeue_attempt="0"} 1
+# HELP tpudist_run_info Info-style run/attempt identity: join scrapes \
+from different requeue attempts of one run_id on these labels.
+# TYPE tpudist_run_info gauge
+tpudist_run_info{run_id="r1",requeue_attempt="0"} 1
 # HELP tpudist_step Last global step seen on the metrics stream.
 # TYPE tpudist_step gauge
 tpudist_step 8
@@ -530,6 +540,7 @@ tpudist_alert_firing{alert="stall"} 1
 tpudist_alert_firing{alert="ttft"} 0
 tpudist_alert_firing{alert="itl"} 0
 tpudist_alert_firing{alert="tokens_per_chip"} 0
+tpudist_alert_firing{alert="goodput"} 0
 # HELP tpudist_alerts_total Alert fire/resolve transitions so far.
 # TYPE tpudist_alerts_total counter
 tpudist_alerts_total 1
@@ -736,6 +747,10 @@ def test_train_live_end_to_end(tmp_path, capsys, monkeypatch):
     """--live on: the run loops back over a real socket, the aggregator
     ends ok, every artifact carries the same run_id."""
     monkeypatch.setenv("TPUDIST_RUN_ID", "e2e-live-1")
+    # a seconds-long CPU run is startup-dominated by construction; the
+    # production goodput floor would (correctly) end the run in alert
+    # state, which is not what THIS test pins
+    monkeypatch.setenv("TPUDIST_GOODPUT_MIN", "0.001")
     save = str(tmp_path / "ck")
     rc, out = _run_train(capsys, [
         "--epochs", "2", "--train-batch-size", "64", "--n-samples",

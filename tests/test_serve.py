@@ -583,7 +583,8 @@ def test_report_serving_section_and_verdict():
                            "tokens_per_chip": "success"}
     assert sv["queue_over_time"][0]["queue_depth"] == 3
     assert rep["verdict"] == report_lib.SUCCESS
-    assert rep["schema"] == 4
+    assert rep["schema"] == report_lib.REPORT_SCHEMA_VERSION  # >=5 adds
+    # the Goodput section after the serving one this test pins
     md = report_lib.to_markdown(rep)
     assert "## Serving (latency SLOs)" in md
     assert "serve_status: success" in md

@@ -97,18 +97,37 @@ def missing_worker_verdicts(verdict_path: Optional[str],
     return missing
 
 
-def _worker_ids(flightrec_dir: str, prefix: str) -> set:
+def _worker_ids(flightrec_dir: str, prefix: str,
+                attempt: Optional[int] = None) -> set:
     ids = set()
     for path in glob.glob(os.path.join(flightrec_dir, "**",
                                        f"{prefix}.worker*"),
                           recursive=True):
         tail = os.path.basename(path).rsplit(".worker", 1)[-1]
-        if tail.isdigit():
-            ids.add(int(tail))
+        if not tail.isdigit():
+            # archived beacons (heartbeat.worker<i>.attempt<K>) are by
+            # definition another attempt's evidence — never counted
+            continue
+        if attempt is not None and prefix == "heartbeat":
+            # attempt-scoped liveness: a stale beacon from attempt N-1
+            # left by a worker that never STARTED in attempt N must not
+            # make it read as alive-then-vanished; the payload stamps
+            # the attempt it beat for (beacons too old to carry the
+            # stamp keep the pre-namespacing behavior: counted)
+            try:
+                with open(path) as f:
+                    stamped = json.load(f).get("requeue_attempt")
+            except (OSError, ValueError):
+                continue        # a torn beacon is not evidence
+            if isinstance(stamped, (int, float)) \
+                    and int(stamped) != attempt:
+                continue
+        ids.add(int(tail))
     return ids
 
 
-def vanished_workers(flightrec_dir: Optional[str]) -> List[int]:
+def vanished_workers(flightrec_dir: Optional[str],
+                     attempt: Optional[int] = None) -> List[int]:
     """Vanished-worker inference from the collected artifacts alone (no
     --verdict/--nprocs wiring needed): every live worker writes a
     ``heartbeat.worker<i>`` beacon within seconds of starting, and every
@@ -116,18 +135,21 @@ def vanished_workers(flightrec_dir: Optional[str]) -> List[int]:
     (train.main's finally) — a worker with a beacon but no verdict died
     un-orderly, i.e. was preempted. The launcher points the workers'
     TPUDIST_VERDICT_PATH into the same OBS_DIR it collects (and clears
-    both between attempts), so the sets line up per attempt. Empty when
+    both between attempts), so the sets line up per attempt; passing
+    ``attempt`` additionally scopes beacons to the attempt they were
+    written FOR (the payload's ``requeue_attempt`` stamp). Empty when
     beacons are absent entirely (nothing to infer from)."""
     if not flightrec_dir or not os.path.isdir(flightrec_dir):
         return []
-    expected = _worker_ids(flightrec_dir, "heartbeat")
+    expected = _worker_ids(flightrec_dir, "heartbeat", attempt)
     wrote = _worker_ids(flightrec_dir, "job_status.txt")
     return sorted(expected - wrote) if expected else []
 
 
 def classify(rc: int, *, flightrec_dir: Optional[str] = None,
              verdict_path: Optional[str] = None,
-             nprocs: Optional[int] = None) -> str:
+             nprocs: Optional[int] = None,
+             attempt: Optional[int] = None) -> str:
     """Map one failed (or succeeded) run's evidence to a verdict."""
     if rc == 0:
         return SUCCESS
@@ -142,7 +164,7 @@ def classify(rc: int, *, flightrec_dir: Optional[str] = None,
     missing = missing_worker_verdicts(verdict_path, nprocs)
     if missing:
         return PREEMPTION
-    if vanished_workers(flightrec_dir):
+    if vanished_workers(flightrec_dir, attempt):
         return PREEMPTION
     return CRASH
 
@@ -175,7 +197,8 @@ def decide(rc: int, *, attempt: int, max_requeues: int,
            base_s: float = BACKOFF_BASE_S,
            max_s: float = BACKOFF_MAX_S) -> Decision:
     verdict = classify(rc, flightrec_dir=flightrec_dir,
-                       verdict_path=verdict_path, nprocs=nprocs)
+                       verdict_path=verdict_path, nprocs=nprocs,
+                       attempt=attempt)
     if verdict == SUCCESS:
         return Decision(verdict, False, 0.0, "run succeeded")
     if verdict == CRASH:

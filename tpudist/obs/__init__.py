@@ -18,7 +18,10 @@ of a timeout (DESIGN.md "Observability"):
     ``jax.profiler`` captures (compute vs exposed-communication split)
     plus the ``--profile-window`` capture mode;
   * :mod:`report` — the offline run-report CLI over the merged trace
-    plus ``metrics.jsonl`` (``python -m tpudist.obs.report``).
+    plus ``metrics.jsonl`` (``python -m tpudist.obs.report``);
+  * :mod:`goodput` — the cross-attempt goodput ledger: productive vs
+    badput wall-clock across every requeue attempt of a ``run_id``
+    (``python -m tpudist.obs.goodput``).
 
 :class:`PodObserver` is the facade the train loop wires through: one
 object to start, feed progress, ask for record fields, and close.
@@ -54,7 +57,7 @@ class PodObserver:
                  hbm_sample_s: float = 2.0, metrics: Any = None,
                  process_index: int = 0, process_count: int = 1,
                  stall_hook: Any = None, live: Any = None,
-                 live_fields: Any = None):
+                 live_fields: Any = None, requeue_attempt: int = 0):
         self.hbm = (HbmSampler(period_s=hbm_sample_s)
                     if hbm_sample_s > 0 else None)
         self.hosts = HostStepStats(process_index=process_index,
@@ -94,7 +97,8 @@ class PodObserver:
             extra_state=_extra_state,
             tracer=trace.get(), stall_hook=stall_hook,
             emitter=(live.emitter if live is not None else None),
-            beacon_extra=_beacon_extra)
+            beacon_extra=_beacon_extra,
+            requeue_attempt=requeue_attempt)
         self._closed = False
 
     @classmethod
@@ -102,16 +106,22 @@ class PodObserver:
                     process_count: int = 1,
                     stall_hook: Any = None, live: Any = None,
                     live_fields: Any = None) -> "PodObserver":
-        from tpudist.config import resolve_obs
+        from tpudist.config import resolve_obs, resolve_requeue_attempt
         stall_s, out_dir, hbm_s = resolve_obs(cfg)
         return cls(out_dir=out_dir, stall_timeout_s=stall_s,
                    hbm_sample_s=hbm_s, metrics=metrics,
                    process_index=process_index,
                    process_count=process_count, stall_hook=stall_hook,
-                   live=live, live_fields=live_fields)
+                   live=live, live_fields=live_fields,
+                   requeue_attempt=resolve_requeue_attempt(cfg))
 
     def note_progress(self, **kv: Any) -> None:
         self.recorder.note_progress(**kv)
+
+    def beacon_now(self) -> None:
+        """One synchronous beacon write (the scripted-kill stamp —
+        FlightRecorder.beacon_now)."""
+        self.recorder.beacon_now()
 
     def epoch_end(self, epoch: int, timer, metrics) -> str:
         """Per-host step-stat aggregation (collective on multi-host —

@@ -90,7 +90,8 @@ class FlightRecorder:
                  last_n_spans: int = 64,
                  stall_hook: Optional[Callable[[], Optional[str]]] = None,
                  emitter: Any = None,
-                 beacon_extra: Optional[Callable[[], Dict]] = None):
+                 beacon_extra: Optional[Callable[[], Dict]] = None,
+                 requeue_attempt: int = 0):
         if stall_timeout_s < 0:
             raise ValueError(
                 f"stall_timeout_s must be >= 0, got {stall_timeout_s}")
@@ -105,17 +106,28 @@ class FlightRecorder:
         self.beacon_extra = beacon_extra
         self.last_n_metrics = last_n_metrics
         self.last_n_spans = last_n_spans
+        self.requeue_attempt = int(requeue_attempt)
         self.beacon_path = os.path.join(
             out_dir, f"heartbeat.worker{process_index}")
         self.flightrec_path = os.path.join(
             out_dir, f"flightrec.worker{process_index}")
         self.dumps = 0          # flight records written (tests read this)
         self.beacons = 0        # beacon writes (tests read this)
+        # beacon namespacing across requeue attempts: an earlier
+        # attempt's beacon left in a shared obs dir must never read as
+        # THIS attempt's progress (the goodput ledger and the launcher's
+        # vanished-worker inference both key off beacons per attempt) —
+        # archive it under its own attempt suffix before the first
+        # write. The dead attempt's progress counters survive under
+        # heartbeat.worker<i>.attempt<K>, where the cross-attempt
+        # ledger finds them.
+        self._archive_stale_beacon()
         # progress is replaced wholesale (never mutated) so the watchdog
         # thread always reads a consistent snapshot without a lock
         self._progress: Dict[str, Any] = {
             "phase": "init", "step": -1, "epoch": -1, "ts": time.time(),
-            "process_index": process_index, "pid": os.getpid()}
+            "process_index": process_index, "pid": os.getpid(),
+            "requeue_attempt": self.requeue_attempt}
         self._count = 0
         self._stop = threading.Event()
         period = _MAX_PERIOD_S
@@ -126,6 +138,28 @@ class FlightRecorder:
         self._thread = threading.Thread(
             target=self._loop, name="tpudist-flightrec", daemon=True)
         self._thread.start()
+
+    def _archive_stale_beacon(self) -> None:
+        """Move a previous attempt's beacon aside (best-effort): the
+        payload names its own attempt, so the archive keeps the attempt
+        the data belongs to — NOT the one that found it."""
+        try:
+            with open(self.beacon_path) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            return     # absent or torn: this attempt's writes overwrite
+        stale = payload.get("requeue_attempt")
+        stale = int(stale) if isinstance(stale, (int, float)) else 0
+        if stale == self.requeue_attempt:
+            return     # same attempt restarted in place: just overwrite
+        try:
+            os.replace(self.beacon_path,
+                       f"{self.beacon_path}.attempt{stale}")
+        except OSError:
+            try:
+                os.remove(self.beacon_path)
+            except OSError:
+                pass   # unremovable beats unreadable: first write wins
 
     # ------------------------------------------------------- hot path
     def note_progress(self, **kv: Any) -> None:
@@ -138,6 +172,16 @@ class FlightRecorder:
     @property
     def progress(self) -> Dict[str, Any]:
         return self._progress
+
+    def beacon_now(self) -> None:
+        """Write one beacon synchronously, off the watchdog cadence.
+        The scripted preemption (train._maybe_test_kill) calls this
+        before ``os._exit``: at production step rates the periodic
+        beacon is at most a step or two stale when a reaper lands, but
+        a CPU drill runs its whole epoch inside one beacon period —
+        this stamp reproduces the realistic ~fresh beacon a real kill
+        leaves, so the lost-step accounting stays deterministic."""
+        self._write_beacon()
 
     # ------------------------------------------------- watchdog thread
     def _loop(self) -> None:

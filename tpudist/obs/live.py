@@ -441,6 +441,15 @@ class LiveAggregator:
             self.engine.observe("tokens_per_chip",
                                 rec.get("tokens_per_sec_per_chip"),
                                 step=step)
+        elif kind == "goodput":
+            # the run-end attempt-local goodput estimate
+            # (obs.goodput.attempt_record): the same observable the
+            # offline cross-attempt ledger refines, graded against the
+            # same rules-table floor
+            frac = rec.get("fraction")
+            self._pod["goodput_fraction"] = frac
+            self.engine.observe("goodput", frac,
+                                step=self._pod.get("step"))
         elif kind == "stall_dump":
             # the watchdog's last gasp: the worker MEASURED this many
             # seconds without step progress before dumping — observe it
@@ -720,6 +729,9 @@ class LiveAggregator:
 _PROM_HELP = {
     "tpudist_up": "Live aggregator is running.",
     "tpudist_info": "Run identity (labels carry run_id and attempt).",
+    "tpudist_run_info": "Info-style run/attempt identity: join scrapes "
+                        "from different requeue attempts of one run_id "
+                        "on these labels.",
     "tpudist_step": "Last global step seen on the metrics stream.",
     "tpudist_epoch": "Last epoch seen on the metrics stream.",
     "tpudist_steps_per_sec": "Pod steps/s (last measured).",
@@ -731,6 +743,9 @@ _PROM_HELP = {
     "tpudist_exposed_comm_fraction": "Exposed-communication fraction "
                                      "of the device window.",
     "tpudist_straggler_ratio": "Worst host step time over pod median.",
+    "tpudist_goodput_fraction": "Attempt-local productive fraction of "
+                                "wall clock (run-end estimate; the "
+                                "cross-attempt ledger refines it).",
     "tpudist_ckpt_last_enqueue_ms": "Last checkpoint enqueue cost.",
     "tpudist_ckpt_drain_ms": "Run-total checkpoint drain cost.",
     "tpudist_host_step": "Per-host last step from its heartbeat.",
@@ -793,6 +808,13 @@ def prometheus_text(status: Dict[str, Any]) -> str:
                               "requeue_attempt":
                                   str(status.get("requeue_attempt", 0))},
                              1)])
+    # the info-style join key for cross-attempt dashboards: scrapes
+    # from different requeue attempts of one run_id join on exactly
+    # these labels (tpudist_info predates it and stays for compat)
+    metric("tpudist_run_info",
+           [({"run_id": status.get("run_id") or "",
+              "requeue_attempt":
+                  str(status.get("requeue_attempt", 0))}, 1)])
     metric("tpudist_step", [({}, pod.get("step"))])
     metric("tpudist_epoch", [({}, pod.get("epoch"))])
     metric("tpudist_steps_per_sec", [({}, pod.get("steps_per_sec"))])
@@ -805,6 +827,8 @@ def prometheus_text(status: Dict[str, Any]) -> str:
            [({}, pod.get("exposed_comm_frac"))])
     metric("tpudist_straggler_ratio",
            [({}, pod.get("straggler_ratio"))])
+    metric("tpudist_goodput_fraction",
+           [({}, pod.get("goodput_fraction"))])
     metric("tpudist_ckpt_last_enqueue_ms",
            [({}, pod.get("ckpt_last_enqueue_ms"))])
     metric("tpudist_ckpt_drain_ms", [({}, pod.get("ckpt_drain_ms"))])

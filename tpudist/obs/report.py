@@ -42,11 +42,53 @@ from typing import Any, Dict, List, Optional, Sequence
 
 from tpudist import rules as rules_lib
 from tpudist.obs import devtime as devtime_mod
+from tpudist.obs import goodput as goodput_mod
+from tpudist.obs import live as live_mod
 from tpudist.serve import slo as slo_mod
 
-# Schema 4: adds the "serving" section (latency percentiles, queue
-# depth over time, SLO verdict vs optional baseline — tpudist.serve).
-REPORT_SCHEMA_VERSION = 4
+# Schema 5: adds the "goodput" section (cross-attempt wall-clock
+# partition from the goodput ledger — tpudist.obs.goodput — or the
+# run-end kind=goodput record for single-attempt runs).
+REPORT_SCHEMA_VERSION = 5
+
+# Artifact schemas this reader KNOWS. A newer number is a warning, not
+# a failure: a requeue loop can scatter attempts across tpudist
+# versions (the slice is re-provisioned, images drift), and a
+# mixed-version attempt directory must still fold into ONE report —
+# the known fields are read, unknown ones ignored.
+KNOWN_ARTIFACT_SCHEMAS = {
+    # mirrors obs.trace.TRACE_SCHEMA_VERSION — the one constant that
+    # CANNOT be imported here (trace.py imports jax; this CLI must run
+    # with jax uninstalled). tests/test_goodput.py diffs the two.
+    "trace": 1,
+    "alerts": live_mod.LIVE_SCHEMA_VERSION,
+    "goodput": goodput_mod.GOODPUT_SCHEMA_VERSION,
+    "baseline": REPORT_SCHEMA_VERSION,
+}
+
+
+def warn_newer_schema(doc: Any, what: str,
+                      known: Optional[int] = None) -> bool:
+    """Forward-compat gate for every artifact this CLI loads: an
+    artifact stamped with a schema NEWER than this reader knows gets a
+    stderr warning and is read anyway (known fields only). Returns
+    whether it warned (tests pin the path)."""
+    if known is None:
+        known = KNOWN_ARTIFACT_SCHEMAS[what]
+    if not isinstance(doc, dict):
+        return False
+    s = doc.get("schema")
+    if s is None:
+        s = (doc.get("metadata") or {}).get("schema") \
+            if isinstance(doc.get("metadata"), dict) else None
+    if isinstance(s, (int, float)) and s > known:
+        print(f"tpudist.obs.report: {what} artifact carries schema "
+              f"{int(s)} > known {known} — reading the fields this "
+              f"version knows, ignoring the rest (a mixed-version "
+              f"attempt set still folds into one report)",
+              file=sys.stderr)
+        return True
+    return False
 
 SUCCESS = "success"
 FAIL = "fail"
@@ -84,6 +126,7 @@ def load_trace(path: str) -> Dict[str, Any]:
     if "traceEvents" not in doc:
         raise ValueError(f"{path}: not a Chrome trace-event document "
                          f"(no traceEvents key)")
+    warn_newer_schema(doc, "trace")
     return doc
 
 
@@ -604,6 +647,58 @@ def serving_section(metrics: List[Dict[str, Any]],
     }
 
 
+def goodput_section(metrics: List[Dict[str, Any]],
+                    ledger: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """The goodput slice of the report (tpudist.obs.goodput): the
+    cross-attempt wall-clock partition when a ledger is available
+    (attempts.jsonl next to the artifacts, or a prebuilt goodput.json),
+    else the run-end ``kind=goodput`` attempt-local estimate. The
+    status is RE-GRADED through the shared rules table at fold time
+    (env read now — same discipline as the serving section), but the
+    fraction itself is the ledger's verbatim: the CLI, this section and
+    the Prometheus gauges must report the identical number (the
+    consumer-parity pin in tests/test_goodput.py)."""
+    if ledger:
+        frac = ledger.get("goodput_fraction")
+        return {
+            "enabled": True,
+            "cross_attempt": True,
+            "status": goodput_mod.goodput_status(frac),
+            "fraction": frac,
+            "min_fraction": rules_lib.resolve("goodput"),
+            "total_wall_s": ledger.get("total_wall_s"),
+            "buckets": ledger.get("totals"),
+            "lost_steps": ledger.get("lost_steps"),
+            "exact": ledger.get("exact"),
+            "tolerance": ledger.get("tolerance"),
+            "problems": ledger.get("problems") or [],
+            "attempts": [
+                {k: a.get(k) for k in
+                 ("attempt", "wall_s", "rc", "verdict", "steps_done",
+                  "lost_steps", "steps_per_sec", "buckets")}
+                for a in ledger.get("attempts", [])],
+        }
+    recs = [r for r in metrics if r.get("kind") == "goodput"]
+    if not recs:
+        return {"enabled": False}
+    g = recs[-1]
+    return {
+        "enabled": True,
+        "cross_attempt": False,
+        "status": goodput_mod.goodput_status(g.get("fraction")),
+        "fraction": g.get("fraction"),
+        "min_fraction": rules_lib.resolve("goodput"),
+        "total_wall_s": g.get("wall_s"),
+        "buckets": {k: g.get(f"{k}_s") for k in goodput_mod.BUCKETS
+                    if g.get(f"{k}_s") is not None},
+        "lost_steps": None,
+        "exact": None,
+        "attempts": [{"attempt": g.get("requeue_attempt"),
+                      "wall_s": g.get("wall_s")}],
+    }
+
+
 def _find_serve_tps(doc: Any) -> Optional[float]:
     """Dig a serve tokens/s/chip baseline out of a document: a
     BENCH_SERVE.json (top-level ``value`` under the serve metric name),
@@ -649,7 +744,8 @@ def build_report(metrics: List[Dict[str, Any]],
                  baseline: Optional[Dict] = None,
                  regress_min: Optional[float] = None,
                  collectives: Optional[Dict] = None,
-                 alert_history: Optional[List[Dict]] = None
+                 alert_history: Optional[List[Dict]] = None,
+                 goodput: Optional[Dict] = None
                  ) -> Dict[str, Any]:
     if regress_min is None:
         # the shared rules table (same env knob, read at call time, as
@@ -674,6 +770,7 @@ def build_report(metrics: List[Dict[str, Any]],
     devtime = devtime_section(all_events, metrics, baseline)
     alerts = alerts_section(metrics, alert_history, timing)
     serving = serving_section(metrics, baseline)
+    goodput_sec = goodput_section(metrics, goodput)
     # the correlation id: every metrics record carries it (the train
     # CLI stamps MetricsLogger.extra); older artifacts fall back to the
     # trace metadata
@@ -742,6 +839,7 @@ def build_report(metrics: List[Dict[str, Any]],
         "stragglers": stragglers,
         "regression": regression,
         "serving": serving,
+        "goodput": goodput_sec,
         "alerts": alerts,
         "verdict": verdict,
     }
@@ -886,6 +984,49 @@ def to_markdown(report: Dict[str, Any]) -> str:
                       f"({t.get('source')}, {t.get('trials')} trial(s)) "
                       f"→ decode_k {t.get('decode_k')}, layout "
                       f"{t.get('layout')}", ""]
+    gp = r.get("goodput") or {}
+    if gp.get("enabled"):
+        frac = gp.get("fraction")
+        scope = ("across attempts" if gp.get("cross_attempt")
+                 else "this attempt (run-end estimate)")
+        lines += ["## Goodput (wall-clock accounting)", "",
+                  f"**goodput_status: {gp['status']}** — "
+                  + (f"{100 * frac:.1f}%" if frac is not None else "—")
+                  + f" of {gp.get('total_wall_s') or 0:.2f}s wall was "
+                    f"productive step time {scope} (floor "
+                    f"{100 * gp['min_fraction']:.0f}%)"]
+        if gp.get("cross_attempt"):
+            lines += [f"- partition "
+                      + ("exact" if gp.get("exact") else "**INEXACT**")
+                      + f" (±{100 * (gp.get('tolerance') or 0):.0f}% "
+                        f"pinned), {gp.get('lost_steps')} step(s) lost "
+                        f"to preemption"]
+        bk = gp.get("buckets") or {}
+        if bk:
+            lines.append("- buckets: " + ", ".join(
+                f"{k} {v:.2f}s" for k, v in bk.items()
+                if isinstance(v, (int, float))))
+        lines.append("")
+        atts = gp.get("attempts") or []
+        if gp.get("cross_attempt") and atts:
+            lines += ["| attempt | wall s | rc | verdict | steps | "
+                      "lost | productive s | residue s |",
+                      "|---|---|---|---|---|---|---|---|"]
+            for a in atts:
+                ab = a.get("buckets") or {}
+                lines.append(
+                    f"| {a.get('attempt')} | "
+                    f"{a.get('wall_s') or 0:.2f} | {a.get('rc')} | "
+                    f"{a.get('verdict') or '—'} | "
+                    f"{a.get('steps_done') if a.get('steps_done') is not None else '—'} | "
+                    f"{a.get('lost_steps') if a.get('lost_steps') is not None else '—'} | "
+                    f"{ab.get('productive', 0.0):.2f} | "
+                    f"{ab.get('residue', 0.0):.2f} |")
+            lines.append("")
+        for p in gp.get("problems") or []:
+            lines.append(f"- ⚠️ {p}")
+        if gp.get("problems"):
+            lines.append("")
     al = r.get("alerts") or {}
     if al.get("enabled"):
         lines += ["## Alerts (live telemetry)", ""]
@@ -958,6 +1099,17 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "log) or a live_status.json (default: <run-dir>/"
                         "alerts.jsonl, else <run-dir>/live_status.json "
                         "when present)")
+    p.add_argument("--goodput", type=str, default=None,
+                   help="prebuilt goodput ledger JSON (python -m "
+                        "tpudist.obs.goodput) for the Goodput section "
+                        "(default: <run-dir>/goodput.json when "
+                        "present)")
+    p.add_argument("--attempts", type=str, default=None,
+                   help="attempts.jsonl (launcher-written, one record "
+                        "per requeue attempt): when present — or found "
+                        "in <run-dir> — the cross-attempt goodput "
+                        "ledger is built here and folded into the "
+                        "Goodput section")
     p.add_argument("--regress-min", type=float, default=None,
                    help=f"regression floor as a fraction of baseline "
                         f"steps/s (default $TPUDIST_REGRESS_MIN, else "
@@ -992,6 +1144,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if args.baseline:
         with open(args.baseline) as f:
             baseline = json.load(f)
+        warn_newer_schema(baseline, "baseline")
     collectives = None
     coll_path = args.collectives or os.path.join(run_dir,
                                                  "BENCH_COLLECTIVES.json")
@@ -1022,13 +1175,42 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                                  for line in f if line.strip()]
             else:
                 # a live_status.json: the final snapshot's full history
-                alert_history = (json.load(f).get("alerts") or {}).get(
+                status_doc = json.load(f)
+                warn_newer_schema(status_doc, "alerts")
+                alert_history = (status_doc.get("alerts") or {}).get(
                     "history", [])
+
+    # the goodput ledger: a prebuilt goodput.json wins; else an
+    # attempts.jsonl (given or discovered in the run dir) builds the
+    # cross-attempt ledger right here (goodput is jax-free like this
+    # whole CLI); single-attempt runs fall back to the kind=goodput
+    # record inside build_report
+    goodput_doc = None
+    gp_path = args.goodput or os.path.join(run_dir, "goodput.json")
+    if args.goodput and not os.path.exists(gp_path):
+        print(f"tpudist.obs.report: missing goodput file {gp_path}",
+              file=sys.stderr)
+        return 2
+    if os.path.exists(gp_path):
+        with open(gp_path) as f:
+            goodput_doc = json.load(f)
+        warn_newer_schema(goodput_doc, "goodput")
+    else:
+        attempts_path = args.attempts or os.path.join(
+            run_dir, goodput_mod.ATTEMPTS_NAME)
+        if args.attempts and not os.path.exists(attempts_path):
+            print(f"tpudist.obs.report: missing attempts file "
+                  f"{attempts_path}", file=sys.stderr)
+            return 2
+        if os.path.exists(attempts_path):
+            goodput_doc = goodput_mod.build_from_dir(
+                run_dir, attempts_path=attempts_path)
 
     report = build_report(metrics, trace_doc, baseline=baseline,
                           regress_min=args.regress_min,
                           collectives=collectives,
-                          alert_history=alert_history)
+                          alert_history=alert_history,
+                          goodput=goodput_doc)
     out_json = args.out_json or os.path.join(run_dir, "run_report.json")
     out_md = args.out_md or os.path.join(run_dir, "run_report.md")
     for path, payload in ((out_json, json.dumps(report, indent=1)),

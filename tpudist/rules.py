@@ -50,6 +50,14 @@ TTFT_P99_MAX = 2.0          # serve: p99 time-to-first-token (seconds)
 ITL_P99_MAX = 1.0           # serve: p99 inter-token latency (seconds)
 TOKENS_PER_CHIP_MIN = 1.0   # serve: decode throughput floor (tok/s/chip)
 
+# Goodput (tpudist.obs.goodput): productive training time as a fraction
+# of the run's total wall-clock — cross-attempt in the offline ledger,
+# attempt-local in the run-end kind=goodput record the live engine
+# watches. The default is deliberately loose (spot capacity routinely
+# eats half a run in requeues before anyone calls it broken); CI drills
+# and production deployments tighten it via the env override.
+GOODPUT_MIN = 0.5           # obs.goodput.goodput_status
+
 
 @dataclass(frozen=True)
 class Threshold:
@@ -132,6 +140,14 @@ THRESHOLDS: Tuple[Threshold, ...] = (
         observable="generated tokens per second per chip",
         description="below this floor the pod serves fewer users than "
                     "its chip count should carry"),
+    Threshold(
+        name="goodput", env="TPUDIST_GOODPUT_MIN",
+        default=GOODPUT_MIN, sense="min", alert=True,
+        observable="productive training fraction of wall clock "
+                   "(cross-attempt in the ledger, attempt-local live)",
+        description="below this the pod burns its wall-clock on "
+                    "compile, exposed transfer, lost progress and "
+                    "requeue gaps instead of training"),
 )
 
 ALERT_RULES: Tuple[Threshold, ...] = tuple(

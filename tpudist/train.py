@@ -29,6 +29,7 @@ import numpy as np
 
 from tpudist import checkpoint as ckpt_lib
 from tpudist import data as data_lib
+from tpudist import rules as rules_lib
 from tpudist import engine as engine_lib
 from tpudist import obs as obs_lib
 from tpudist import verdict as verdict_lib
@@ -37,6 +38,7 @@ from tpudist.config import TrainConfig, parse_args
 from tpudist.metrics import (MetricsLogger, StagingStats, StepTimer,
                              device_kind, log0)
 from tpudist.obs import devtime as devtime_lib
+from tpudist.obs import goodput as goodput_lib
 from tpudist.obs import live as live_lib
 from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
@@ -45,7 +47,7 @@ from tpudist.parallel import build_mesh, distributed
 _KILL_SPEC: Optional[tuple] = None
 
 
-def _maybe_test_kill(epoch: int, step: int) -> None:
+def _maybe_test_kill(epoch: int, step: int, observer=None) -> None:
     """Scripted preemption for drills and CI (``TPUDIST_TEST_KILL=
     "<epoch>:<step>[:<rank>]"``): once the given epoch reaches the given
     step-in-epoch, the matching rank (omitted/-1 = every rank — a spot
@@ -55,7 +57,15 @@ def _maybe_test_kill(epoch: int, step: int) -> None:
     kills a run this way and asserts the requeued ``--resume auto`` run
     continues bitwise-identically from the last committed manifest.
     Parsed once per process (the drills always run in subprocesses —
-    an in-process kill would take the test harness with it)."""
+    an in-process kill would take the test harness with it).
+
+    One beacon is stamped before the exit (``observer.beacon_now`` —
+    an atomic file write, nothing flushed or drained): at production
+    step rates the periodic beacon is ≤ one period stale when a real
+    reaper lands, but a CPU drill finishes whole epochs inside one
+    period — the stamp reproduces the realistic ~fresh beacon so the
+    goodput ledger's lost-step accounting (dead beacon step − resumed
+    step) is deterministic in drills."""
     global _KILL_SPEC
     if _KILL_SPEC is None:
         raw = os.environ.get("TPUDIST_TEST_KILL", "")
@@ -72,6 +82,11 @@ def _maybe_test_kill(epoch: int, step: int) -> None:
                                        or kr == jax.process_index()):
         print(f"tpudist: TEST KILL (preemption drill) at epoch {epoch} "
               f"step {step}", flush=True)
+        if observer is not None:
+            try:
+                observer.beacon_now()
+            except Exception:
+                pass
         os._exit(113)
 
 
@@ -86,6 +101,7 @@ def run(cfg: TrainConfig) -> float:
     # identical); --trace off / TPUDIST_TRACE=off is the escape hatch.
     # A fresh tracer per run: back-to-back runs in one process (tests,
     # notebooks) must not mix spans.
+    run_wall_t0 = time.time()   # the attempt-local goodput denominator
     trace_enabled, trace_dir = config_lib.resolve_trace(cfg)
     tracer = trace_lib.configure(enabled=trace_enabled)
     with trace_lib.span("distributed_init", cat="init"):
@@ -166,6 +182,13 @@ def run(cfg: TrainConfig) -> float:
     metrics.extra = {"run_id": run_id, "requeue_attempt": requeue_attempt}
     tracer.run_info = {"run_id": run_id,
                        "requeue_attempt": requeue_attempt}
+    # the attempt's birth certificate, flushed IMMEDIATELY: a killed
+    # attempt's buffered tail dies with it, but this record must
+    # survive — the goodput ledger's startup bucket is the gap from the
+    # launcher's attempts.jsonl start stamp to this line
+    metrics.log(kind="attempt", phase="start",
+                process_count=ctx.process_count)
+    metrics.flush()
 
     # live telemetry bus (obs.live, --live on): the coordinator runs the
     # aggregator + on-line alert engine + Prometheus exporter; EVERY
@@ -534,6 +557,22 @@ def run(cfg: TrainConfig) -> float:
                 trace_spans=(trace_summary or {}).get("spans"),
                 trace_dropped=(trace_summary or {}).get("dropped"),
                 **obs_fields)
+    # attempt-local goodput estimate (obs.goodput): the same bucket
+    # math the cross-attempt ledger applies, over this attempt's own
+    # records and wall — graded against the shared rules floor, fanned
+    # to the live bus (the on-line goodput alert) and refined offline
+    # by the ledger once the launcher's attempts.jsonl adds the
+    # startup/off-pod time only it can see
+    gp = goodput_lib.attempt_record(
+        metrics.history, wall_s=time.time() - run_wall_t0,
+        requeue_attempt=requeue_attempt)
+    if gp is not None:
+        metrics.log(kind="goodput", **gp)
+        log0(f"tpudist: goodput {gp['status']}: "
+             f"{100 * gp['fraction']:.1f}% of this attempt's "
+             f"{gp['wall_s']:.2f}s wall was productive step time "
+             f"(floor {rules_lib.resolve('goodput'):.0%}; "
+             f"cross-attempt ledger: python -m tpudist.obs.goodput)")
     if live is not None:
         # after the timing record above so it reaches the bus; close()
         # drains the emitter, waits (bounded) for in-flight frames, and
@@ -662,7 +701,7 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                 # the beacon's step stops advancing with it)
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=end)
-            _maybe_test_kill(epoch, end)
+            _maybe_test_kill(epoch, end, observer)
             if not dispatched:
                 dispatched = True
                 if timer.warming:
@@ -765,7 +804,7 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             if observer is not None:
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=i + 1)
-            _maybe_test_kill(epoch, i + 1)
+            _maybe_test_kill(epoch, i + 1, observer)
             if i == first and timer.warming:
                 # fence the first step alone so the timer's warmup absorbs
                 # exactly the trace+compile cost, not a whole fence group —
@@ -825,8 +864,12 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
     log0(f"Epoch {epoch + 1:2d} finished. Avg loss: {last_avg:.4f}")
     if observer is not None:
         observer.note_progress(phase="eval", epoch=epoch, step=n_steps)
+    t_eval = time.perf_counter()
     with trace_lib.span("eval", cat="eval", epoch=epoch):
         eval_loss = float(eval_fn(state, eval_batch))
+    # the float() above fenced the forward, so this wall is the real
+    # eval cost — the goodput ledger's eval bucket reads it per epoch
+    eval_s = time.perf_counter() - t_eval
     log0(f"Epoch {epoch + 1:2d} eval loss: {eval_loss:.4f}")
     # per-host step-time aggregation (kind=hosts record + straggler
     # verdict): a collective — every process calls it, at a point where
@@ -844,8 +887,8 @@ def _epoch_end(cfg, state, total, counted, pending, n_steps, epoch, metrics,
     # record is self-describing for loss-parity dashboards (r3
     # advisor finding)
     metrics.log(kind="epoch", epoch=epoch, avg_loss=last_avg,
-                eval_loss=eval_loss, steps_counted=counted,
-                n_steps=n_steps,
+                eval_loss=eval_loss, eval_s=round(eval_s, 6),
+                steps_counted=counted, n_steps=n_steps,
                 steps_per_sec=timer.steps_per_sec(),
                 steps_per_sec_per_chip=timer.steps_per_sec_per_chip())
     # resume position: next epoch from its first batch. Async: blocks

@@ -182,6 +182,26 @@ def comm_status(exposed_frac, max_frac: float | None = None) -> str:
     return _impl(exposed_frac, max_frac)
 
 
+# Goodput gate (tpudist.obs.goodput): productive training time as a
+# fraction of the run's wall-clock — cross-attempt in the offline
+# ledger, attempt-local in the run-end kind=goodput record. Aliased
+# from the shared rules table like every other gate (env override
+# TPUDIST_GOODPUT_MIN, read at call time). Advisory, like comm_status.
+GOODPUT_MIN = rules_lib.GOODPUT_MIN
+
+
+def goodput_status(fraction, min_fraction: float | None = None) -> str:
+    """Three-valued goodput verdict (tpudist.obs.goodput): UNGATEABLE
+    with nothing measured, else SUCCESS/FAIL by whether the productive
+    fraction clears ``TPUDIST_GOODPUT_MIN``. The implementation lives
+    in obs.goodput next to the ledger that produces the fraction; this
+    delegator keeps the verdict surface in one place like the other
+    gates. (Lazy import: goodput mirrors this module's status
+    vocabulary without importing it — same pattern as comm_status.)"""
+    from tpudist.obs.goodput import goodput_status as _impl
+    return _impl(fraction, min_fraction)
+
+
 # Serving SLO gates (tpudist.serve): latency-percentile ceilings plus a
 # throughput floor, graded over the serve loop's measured TTFT/ITL
 # histograms. Aliased from the shared rules table like every other gate
