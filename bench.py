@@ -663,6 +663,29 @@ def run_collective_sweep(out_path: str, kinds: str, min_mb: float,
     return art
 
 
+# ------------------------------------------------------------- chaos drill
+
+
+def run_chaos_drill(out_path: str) -> dict:
+    """The recovery-under-fault headline: drive the seeded fault matrix
+    (tpudist.chaos — seven families, policy → requeue → resume against
+    the real CLI) and write BENCH_CHAOS.json on the BENCH_* harness
+    shape. The measurement half is the invariant checker's report: how
+    many families ended green, with per-family resume/goodput facts in
+    the detail block. The drill driver is jax-free; only its
+    subprocesses touch devices, so this wrapper stays a thin shaper
+    like the collective sweep's (chaos.verify owns the orchestration
+    and the artifact shape — one source for the CLI, this flag and
+    selfcheck)."""
+    from tpudist.chaos import verify as chaos_verify
+
+    art = chaos_verify.bench_artifact(chaos_verify.run_and_verify())
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({k: art[k] for k in ("metric", "value", "unit")}))
+    return art
+
+
 # ------------------------------------------------------------------ matrix
 
 # (model, seq, head, flash, per_chip[, remat]) — meaningful cells only:
@@ -853,6 +876,14 @@ def main() -> None:
     p.add_argument("--collective-min-mb", type=float, default=1)
     p.add_argument("--collective-max-mb", type=float, default=1024)
     p.add_argument("--collective-iters", type=int, default=10)
+    p.add_argument("--chaos-drill", action="store_true",
+                   help="run the seeded fault-injection matrix "
+                        "(tpudist.chaos: kill/hang/slow/corrupt/torn/"
+                        "fs-error/telemetry-garbage against the real "
+                        "CLI) and write BENCH_CHAOS.json — headline = "
+                        "fault families ending green")
+    p.add_argument("--chaos-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_CHAOS.json"))
     p.add_argument("--cell", type=str, default=None,
                    help="internal: run one matrix cell "
                         "(model:seq:head:flash:per_chip:remat)")
@@ -887,6 +918,9 @@ def main() -> None:
                              args.collective_min_mb,
                              args.collective_max_mb,
                              args.collective_iters)
+        return
+    if args.chaos_drill:
+        run_chaos_drill(args.chaos_out)
         return
     if args.matrix:
         run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)

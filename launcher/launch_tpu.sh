@@ -121,6 +121,22 @@ MAX_REQUEUES="${MAX_REQUEUES:-0}"
 # resume from, so a failed serve run stops instead of looping
 [ "$MODE" = "serve" ] && MAX_REQUEUES=0
 REQUEUE_BACKOFF_S="${REQUEUE_BACKOFF_S:-10}"
+# Requeue jitter: a zone-wide capacity event preempts EVERY pod of a
+# fleet at once, and identical exponential backoffs would march all
+# their launchers back into queued-resources create at the same
+# instant (a re-provisioning stampede). Each sleep therefore adds a
+# bounded DETERMINISTIC jitter — up to this fraction of the backoff,
+# derived from RUN_ID+attempt (cksum), so it differs across pods but
+# replays exactly per launcher (the launcher test pins the value, and
+# REQUEUE_BACKOFF_S=0 drills stay sleep-free).
+REQUEUE_JITTER_FRAC="${REQUEUE_JITTER_FRAC:-0.25}"
+
+jitter_s() {  # jitter_s <backoff_s> <attempt> -> seconds in [0, frac*backoff)
+  local h
+  h=$(printf '%s:%s' "$RUN_ID" "$2" | cksum | cut -d' ' -f1)
+  awk -v b="$1" -v h="$h" -v f="$REQUEUE_JITTER_FRAC" \
+    'BEGIN{printf "%.3f", b * f * (h % 1000) / 1000}'
+}
 # ONE run id for the whole launch, every attempt included: the workload
 # stamps it into every artifact (tpudist.obs.live.resolve_run_id
 # prefers $TPUDIST_RUN_ID), so a requeue loop's attempts correlate
@@ -478,10 +494,13 @@ while :; do
   if [ "$POLICY_RC" -eq 0 ]; then
     BACKOFF=$(printf '%s\n' "$DECISION" \
       | sed -n 's/.*BACKOFF_S=\([0-9.]*\).*/\1/p')
+    BACKOFF="${BACKOFF:-$REQUEUE_BACKOFF_S}"
+    JITTER=$(jitter_s "$BACKOFF" "$attempt")
     attempt=$((attempt + 1))
-    echo "⟳ requeue attempt $attempt/$MAX_REQUEUES after" \
-         "${BACKOFF:-$REQUEUE_BACKOFF_S}s backoff (--resume auto)"
-    sleep "${BACKOFF:-$REQUEUE_BACKOFF_S}"
+    echo "⟳ requeue attempt $attempt/$MAX_REQUEUES after ${BACKOFF}s" \
+         "backoff + ${JITTER}s jitter (--resume auto)"
+    sleep "$(awk -v a="$BACKOFF" -v j="$JITTER" \
+      'BEGIN{printf "%.3f", a + j}')"
     continue
   fi
   fail_verdict

@@ -1,0 +1,310 @@
+"""Chaos plane (tpudist.chaos): the fault schedule, the injection
+runtime, and the end-to-end corrupt-shard drill.
+
+The plan/runtime tests are in-process and scripted (injected exits,
+fake emitters) — determinism is the contract under test. The
+end-to-end test runs ONE family of the drill matrix (corrupt_shard —
+the resume-fallback satellite) through real subprocesses; the full
+seven-family matrix is slow-marked here and runs green in the CI chaos
+lane via ``selfcheck check_chaos``.
+"""
+
+import json
+import os
+
+import pytest
+
+from tpudist.chaos import drill as drill_mod
+from tpudist.chaos import inject as inject_mod
+from tpudist.chaos import plan as plan_mod
+from tpudist.chaos import verify as verify_mod
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ------------------------------------------------------------- the plan
+
+
+def test_parse_full_grammar():
+    p = plan_mod.ChaosPlan.parse(
+        " kill@0:5 ; hang@1:2:3,rc=137,max_s=9.5 ;"
+        "corrupt_shard@0:6,mode=flip; fs_error@0:3,n=2,errno=ENOSPC ")
+    kinds = [e.kind for e in p.events]
+    assert kinds == ["kill", "hang", "corrupt_shard", "fs_error"]
+    hang = p.events[1]
+    assert (hang.epoch, hang.step, hang.rank) == (1, 2, 3)
+    assert hang.args == {"rc": 137, "max_s": 9.5}
+    assert p.events[3].args["errno"] == "ENOSPC"
+    assert p.events[0].index == 0 and p.events[3].index == 3
+    assert "kill@0:5" in p.describe()
+
+
+def test_parse_empty_and_rank_matching():
+    assert plan_mod.ChaosPlan.parse(None).events == ()
+    assert plan_mod.ChaosPlan.parse(" ; ").events == ()
+    ev = plan_mod.ChaosPlan.parse("slow@0:3:1,s=0.01").events[0]
+    assert ev.matches(0, 3, 1) and ev.matches(0, 7, 1)   # step >= fires
+    assert not ev.matches(0, 3, 0)                       # wrong rank
+    assert not ev.matches(1, 3, 1)                       # wrong epoch
+    assert not ev.matches(0, 2, 1)                       # too early
+    anyrank = plan_mod.ChaosPlan.parse("kill@0:5").events[0]
+    assert anyrank.matches(0, 5, 0) and anyrank.matches(0, 5, 3)
+
+
+@pytest.mark.parametrize("bad", [
+    "explode@0:5",            # unknown fault
+    "kill@0",                 # no step
+    "kill@a:b",               # non-integer trigger
+    "kill@0:5,rc",            # malformed arg
+    "kill 0:5",               # no @
+])
+def test_parse_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        plan_mod.ChaosPlan.parse(bad)
+
+
+def test_garbage_and_corrupt_positions_deterministic():
+    p = plan_mod.ChaosPlan.parse("telemetry_garbage@0:4,n=64")
+    ev = p.events[0]
+    g1 = plan_mod.garbage_bytes(p, ev)
+    g2 = plan_mod.garbage_bytes(p, ev)
+    assert g1 == g2 and len(g1) == 64
+    # a different seed or event index yields a different stream
+    p2 = plan_mod.ChaosPlan.parse("telemetry_garbage@0:4,n=64", seed=1)
+    assert plan_mod.garbage_bytes(p2, p2.events[0]) != g1
+    pos = plan_mod.corrupt_positions(p, ev, size=1000)
+    assert pos == plan_mod.corrupt_positions(p, ev, size=1000)
+    assert all(250 <= x < 750 for x in pos)   # mid-file: array data
+
+
+# ---------------------------------------------------------- the runtime
+
+
+class _Exit(Exception):
+    def __init__(self, rc):
+        self.rc = rc
+
+
+def _runtime(spec, **kw):
+    rt = inject_mod.ChaosRuntime(plan_mod.ChaosPlan.parse(spec), **kw)
+
+    def fake_exit(rc):
+        raise _Exit(rc)
+    rt._exit = fake_exit
+    return rt
+
+
+def test_runtime_kill_fires_once_with_beacon(capsys):
+    class Obs:
+        beacons = 0
+
+        def beacon_now(self):
+            self.beacons += 1
+    obs = Obs()
+    rt = _runtime("kill@0:5,rc=77", observer=obs)
+    rt.on_step(0, 4)                 # too early: nothing
+    with pytest.raises(_Exit) as e:
+        rt.on_step(0, 5)
+    assert e.value.rc == 77 and obs.beacons == 1 and rt.fired == 1
+    assert "chaos fired: kill@0:5" in capsys.readouterr().out
+
+
+def test_runtime_slow_sleeps_n_steps():
+    sleeps = []
+    rt = _runtime("slow@0:3,s=0.25,steps=2")
+    rt._sleep = sleeps.append
+    for step in range(1, 9):
+        rt.on_step(0, step)
+    assert sleeps == [0.25, 0.25]    # exactly `steps` consecutive fires
+    assert rt.fired == 1             # one record for the whole burst
+
+
+def test_runtime_rank_scoping():
+    rt = _runtime("kill@0:5:2", process_index=0)
+    for step in range(1, 9):
+        rt.on_step(0, step)          # rank 0 never matches rank-2 event
+    rt2 = _runtime("kill@0:5:2", process_index=2)
+    with pytest.raises(_Exit):
+        rt2.on_step(0, 5)
+
+
+def test_runtime_telemetry_garbage_hits_emitter():
+    class Em:
+        blobs = []
+
+        def inject_garbage(self, data):
+            self.blobs.append(bytes(data))
+    em = Em()
+    rt = _runtime("telemetry_garbage@0:4,n=32", emitter=em)
+    rt.on_step(0, 4)
+    rt.on_step(0, 5)                 # fires once
+    assert len(em.blobs) == 1 and len(em.blobs[0]) == 32
+    assert em.blobs[0] == plan_mod.garbage_bytes(rt.plan,
+                                                 rt.plan.events[0])
+
+
+def test_runtime_hang_waits_for_watchdog_dump():
+    class Rec:
+        dumps = 0
+
+    class Obs:
+        recorder = Rec()
+
+        def beacon_now(self):
+            pass
+    obs = Obs()
+    rt = _runtime("hang@0:5,rc=137,max_s=30,settle_s=0", observer=obs)
+    waits = {"n": 0}
+
+    def fake_sleep(s):
+        waits["n"] += 1
+        if waits["n"] == 3:
+            obs.recorder.dumps = 1   # the watchdog fires mid-wedge
+    rt._sleep = fake_sleep
+    with pytest.raises(_Exit) as e:
+        rt.on_step(0, 5)
+    assert e.value.rc == 137 and waits["n"] >= 3
+
+
+def test_runtime_fs_error_bound_to_first_matching_save():
+    rt = _runtime("fs_error@0:3,n=2")
+    kw = dict(step=3, epoch=0, step_in_epoch=3, path=None)
+    with pytest.raises(OSError):
+        rt.ckpt_fault("shard_write", **kw)
+    with pytest.raises(OSError):
+        rt.ckpt_fault("shard_write", **kw)
+    rt.ckpt_fault("shard_write", **kw)          # n exhausted: clean
+    # a LATER save matching step>=3 must not re-fire the consumed event
+    rt.ckpt_fault("shard_write", step=6, epoch=0, step_in_epoch=6,
+                  path=None)
+
+
+def test_runtime_corrupt_shard_flips_bytes(tmp_path):
+    rt = _runtime("corrupt_shard@0:6,mode=flip")
+    p = tmp_path / "worker0.npz"
+    payload = bytes(range(256)) * 8
+    p.write_bytes(payload)
+    rt.ckpt_fault("shard_written", step=6, epoch=0, step_in_epoch=6,
+                  path=str(p))
+    damaged = p.read_bytes()
+    assert damaged != payload and len(damaged) == len(payload)
+    # deterministic: the flipped offsets are the plan's
+    flips = plan_mod.corrupt_positions(rt.plan, rt.plan.events[0],
+                                       len(payload))
+    diff = [i for i, (a, b) in enumerate(zip(payload, damaged))
+            if a != b]
+    assert diff == flips
+
+
+def test_runtime_torn_manifest_kills_after_index(tmp_path):
+    rt = _runtime("torn_manifest@0:6")
+    rt.ckpt_fault("shard_write", step=6, epoch=0, step_in_epoch=6,
+                  path=None)       # other points: no effect
+    rt.ckpt_fault("shard_written", step=6, epoch=0, step_in_epoch=6,
+                  path=None)
+    with pytest.raises(_Exit) as e:
+        rt.ckpt_fault("index_written", step=6, epoch=0, step_in_epoch=6,
+                      path=None)
+    assert e.value.rc == 113
+
+
+def test_runtime_install_uninstall_hook():
+    from tpudist.elastic import ckpt as eck
+    rt = _runtime("torn_manifest@0:6")
+    rt.install()
+    assert eck._FAULT_HOOK == rt.ckpt_fault
+    rt.uninstall()
+    assert eck._FAULT_HOOK is None
+    # a plan with no ckpt events installs nothing
+    rt2 = _runtime("kill@0:5")
+    rt2.install()
+    assert eck._FAULT_HOOK is None
+
+
+# --------------------------------------------------------- the verifier
+
+
+def test_crc_signature_roundtrip(tmp_path, devices8):
+    import jax
+
+    from tpudist import engine
+    from tpudist.config import DataConfig, ParallelConfig, TrainConfig
+    from tpudist.elastic import ckpt as eck
+    from tpudist.parallel import build_mesh
+    cfg = TrainConfig(batch_size=32, data=DataConfig(n_samples=64),
+                      parallel=ParallelConfig(data=1, fsdp=2))
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+    for sub in ("a", "b"):
+        ck = eck.ShardedCheckpointer(str(tmp_path / sub),
+                                     use_async=False)
+        ck.save(state, epoch=0, step_in_epoch=0)
+        ck.close()
+    sa = verify_mod.crc_signature(str(tmp_path / "a"))
+    sb = verify_mod.crc_signature(str(tmp_path / "b"))
+    assert sa is not None and sa == sb            # same bytes, same sig
+    other = engine.init_state(jax.random.PRNGKey(9), cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path / "c"), use_async=False)
+    ck.save(other, epoch=0, step_in_epoch=0)
+    ck.close()
+    assert verify_mod.crc_signature(str(tmp_path / "c")) != sa
+    assert verify_mod.crc_signature(str(tmp_path / "void")) is None
+
+
+def test_chaos_modules_importable_without_jax():
+    """The drill driver and verifier run on the launcher/CI host — the
+    same jax-free contract as policy and goodput."""
+    import subprocess
+    import sys
+    code = ("import sys; sys.modules['jax'] = None; "
+            "from tpudist.chaos import plan, drill, verify; "
+            "p = plan.ChaosPlan.parse('kill@0:5;fs_error@0:3,n=2'); "
+            "assert len(p.events) == 2; "
+            "assert set(drill.FAMILIES) == set(plan.FAULT_KINDS); "
+            "print('ok')")
+    out = subprocess.run([sys.executable, "-c", code], cwd=REPO,
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0 and "ok" in out.stdout, out.stderr
+
+
+# ----------------------------------------------------- end-to-end drill
+
+
+def test_corrupt_shard_drill_falls_back_and_counts_lost(tmp_path):
+    """THE resume-fallback acceptance drill (satellite): the step-6
+    shard is corrupted after its commit, the run is killed at step 7,
+    and the requeued ``--resume auto`` run must crc-reject step 6 and
+    land on the step-3 manifest — kind=resume carrying fallback_from/
+    corrupt_shard, the goodput ledger counting the 4 (not 1) lost
+    steps, and the final state bitwise-identical to the unfaulted
+    baseline."""
+    run_dir = str(tmp_path)
+    drill_mod.run_baseline(run_dir)
+    result = drill_mod.run_family(run_dir, "corrupt_shard")
+    report = verify_mod.verify_family(run_dir, result)
+    assert report["ok"], report["problems"]
+    facts = report["facts"]
+    assert facts["resume"]["resumed_from_step"] == 3
+    assert facts["resume"]["fallback_from"] == 6
+    assert facts["resume"]["corrupt_shard"]
+    assert facts["resume"]["steps_lost"] == 4
+    assert facts["goodput"]["lost_steps"] == 4
+    assert facts["goodput"]["exact"] is True
+    assert facts["final_step"] == 8
+    # and the drill's artifacts carry the flags end to end
+    recs = [json.loads(ln) for ln in open(
+        os.path.join(run_dir, "corrupt_shard", "metrics.jsonl"))]
+    res = [r for r in recs if r.get("kind") == "resume"][-1]
+    assert res["fallback_from"] == 6 and res["corrupt_shard"]
+
+
+@pytest.mark.slow
+def test_full_chaos_matrix_green(tmp_path):
+    """All seven families end green (the CI chaos lane runs this via
+    selfcheck check_chaos; slow here — ~12 subprocess runs)."""
+    results = drill_mod.run_matrix(str(tmp_path))
+    report = verify_mod.verify_matrix(str(tmp_path), results)
+    bad = {k: v["problems"] for k, v in report["families"].items()
+           if not v["ok"]}
+    assert report["ok"], bad
+    assert set(report["families"]) == set(drill_mod.FAMILIES)

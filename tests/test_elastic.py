@@ -234,6 +234,254 @@ def test_commit_waits_for_every_workers_shards(tmp_path, devices8):
     _assert_tree_equal(state, restored)
 
 
+def _corrupt_npz(save_dir, step, *, truncate=False, worker=0):
+    """Damage a committed step's shard file in place: mid-file byte
+    flips (crc-detectable wrong data) or truncation (unreadable zip)."""
+    path = os.path.join(eck.step_dir(eck.elastic_root(save_dir), step),
+                        eck.shards_name(worker))
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        if truncate:
+            f.truncate(size // 2)
+            return path
+        for pos in range(size // 2, size // 2 + 8):
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+def test_shard_index_records_crc32(tmp_path, devices8):
+    """Every shard row in the index carries the crc32 of its raw bytes
+    — the integrity record restore verifies before trusting the
+    checkpoint (a corrupt shard must be DETECTED, never resumed)."""
+    import zlib
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.save(state, epoch=0, step_in_epoch=0)
+    ck.close()
+    d = eck.step_dir(eck.elastic_root(str(tmp_path)), int(state.step))
+    with open(os.path.join(d, eck.index_name(0))) as f:
+        idx = json.load(f)
+    with np.load(os.path.join(d, eck.shards_name(0))) as npz:
+        for name, rec in idx["leaves"].items():
+            for sh in rec["shards"]:
+                assert isinstance(sh["crc32"], int), (name, sh)
+                got = zlib.crc32(np.asarray(npz[sh["key"]]).tobytes()) \
+                    & 0xFFFFFFFF
+                assert got == sh["crc32"], name
+
+
+def test_committed_manifests_newest_first(tmp_path, devices8):
+    """Each commit leaves a per-step manifest copy; the listing returns
+    them newest-first, capped at the top-level manifest (an uncommitted
+    newer step dir must not appear)."""
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    for i in range(3):
+        ck.save(state._replace(step=state.step + i), epoch=i,
+                step_in_epoch=0)
+    ck.close()
+    mans = eck.committed_manifests(str(tmp_path))
+    assert [int(m["step"]) for m in mans] == [2, 1, 0]
+    assert mans[0] == eck.latest_manifest(str(tmp_path))
+
+
+def test_restore_falls_back_to_previous_committed_on_corruption(
+        tmp_path, devices8):
+    """THE corrupt-shard contract (satellite): the newest committed
+    manifest's shard is corrupted on disk — restore must crc-reject it
+    and land on the OLDER committed step, flagging fallback_from and
+    the corrupt shard in the details dict the train loop folds into
+    kind=resume, instead of raising or fresh-starting."""
+    cfg = _cfg(fsdp=4)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:4])
+    s_old = _state(cfg, mesh, seed=1)
+    s_new = _state(cfg, mesh, seed=2)._replace(step=s_old.step + 6)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.save(s_old, epoch=0, step_in_epoch=3)
+    ck.save(s_new, epoch=0, step_in_epoch=6)
+    ck.close()
+    _corrupt_npz(str(tmp_path), int(s_new.step))
+    details = {}
+    restored, epoch, sie = eres.restore(str(tmp_path), s_old,
+                                        details=details)
+    assert (epoch, sie) == (0, 3), (epoch, sie)
+    _assert_tree_equal(s_old, restored)
+    assert details["fallback_from"] == int(s_new.step)
+    # either detection layer may trip first (the npz zip's own member
+    # crc, or our recorded shard crc32) — both read as corruption
+    assert "corrupt" in details["corrupt_shard"]
+
+
+def test_restore_falls_back_on_truncated_shard(tmp_path, devices8):
+    """A TRUNCATED shard file (unreadable zip, the other damage shape)
+    takes the same fallback path as a bit flip."""
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    s_old = _state(cfg, mesh, seed=1)
+    s_new = _state(cfg, mesh, seed=2)._replace(step=s_old.step + 3)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.save(s_old, epoch=0, step_in_epoch=3)
+    ck.save(s_new, epoch=0, step_in_epoch=6)
+    ck.close()
+    _corrupt_npz(str(tmp_path), int(s_new.step), truncate=True)
+    details = {}
+    restored, _, sie = eres.restore(str(tmp_path), s_old,
+                                    details=details)
+    assert sie == 3
+    _assert_tree_equal(s_old, restored)
+    assert details["fallback_from"] == int(s_new.step)
+
+
+def test_recorded_crc_catches_mismatched_bytes(tmp_path, devices8):
+    """The recorded-crc layer specifically (the npz zip's own member
+    crc can't see this shape): the shard index claims a different
+    crc32 than the bytes on disk — e.g. a stale index paired with a
+    rewritten shard file — and restore must reject it."""
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.save(state, epoch=0, step_in_epoch=3)
+    ck.close()
+    d = eck.step_dir(eck.elastic_root(str(tmp_path)), int(state.step))
+    ipath = os.path.join(d, eck.index_name(0))
+    with open(ipath) as f:
+        idx = json.load(f)
+    first = next(iter(idx["leaves"].values()))["shards"][0]
+    first["crc32"] = (first["crc32"] + 1) & 0xFFFFFFFF
+    with open(ipath, "w") as f:
+        json.dump(idx, f)
+    with pytest.raises(eres.ShardCorruptionError, match="crc32"):
+        eres.restore(str(tmp_path), state)
+
+
+def test_restore_raises_when_every_manifest_corrupt(tmp_path, devices8):
+    """No restorable history left: the newest manifest's corruption
+    error propagates (ShardCorruptionError is a ResumeError, so
+    --resume auto degrades it to a flagged fresh start)."""
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.save(state, epoch=0, step_in_epoch=3)
+    ck.close()
+    _corrupt_npz(str(tmp_path), int(state.step))
+    with pytest.raises(eres.ShardCorruptionError):
+        eres.restore(str(tmp_path), state)
+
+
+def test_fs_error_retry_then_skip_never_raises(tmp_path, devices8):
+    """Transient-fs-error hardening: EIO on the first attempts retries
+    away (the save commits); exhaustion ABANDONS that step's commit —
+    counted, logged, never raised into the caller and never a wedged
+    writer — and a later save commits normally."""
+    import errno
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    ck = eck.ShardedCheckpointer(str(tmp_path), use_async=False)
+    ck.write_retry_backoff_s = 0.001
+    fails = {"n": 2}
+
+    def hook(point, **ctx):
+        if point == "shard_write" and fails["n"] > 0:
+            fails["n"] -= 1
+            raise OSError(errno.EIO, "scripted transient EIO")
+    eck.set_fault_hook(hook)
+    try:
+        ck.save(state, epoch=0, step_in_epoch=3)
+        assert ck.write_retries == 2 and ck.write_errors == 0
+        assert int(eck.latest_manifest(str(tmp_path))["step"]) \
+            == int(state.step)
+        # exhaustion: more failures than retries -> skip, don't raise
+        fails["n"] = 99
+        later = state._replace(step=state.step + 3)
+        ck.save(later, epoch=0, step_in_epoch=6)
+        assert ck.write_errors == 1 and ck.write_skips == 1
+        assert int(eck.latest_manifest(str(tmp_path))["step"]) \
+            == int(state.step), "skipped save must not move the manifest"
+        # the writer is NOT wedged: the next save commits
+        fails["n"] = 0
+        final = state._replace(step=state.step + 5)
+        ck.save(final, epoch=1, step_in_epoch=0)
+        assert int(eck.latest_manifest(str(tmp_path))["step"]) \
+            == int(final.step)
+    finally:
+        eck.set_fault_hook(None)
+        ck.close()
+
+
+def test_commit_rendezvous_ignores_stale_attempt_indexes(tmp_path,
+                                                         devices8):
+    """A corruption-FALLBACK resume re-reaches steps whose committed
+    dir still holds the dead attempt's shard indexes (cleanup_stale
+    only reaps dirs NEWER than the manifest) — the rendezvous must NOT
+    let a peer's stale index satisfy this attempt's commit, or the
+    manifest would flip onto the very bytes the fallback rejected. The
+    index stamps its attempt; the commit waits for a fresh one."""
+    cfg = _cfg(fsdp=2)
+    mesh = build_mesh(cfg.parallel, devices=devices8[:2])
+    state = _state(cfg, mesh)
+    # attempt 0: both workers land, the commit flips to epoch 1 (both
+    # constructed BEFORE any save: the coordinator's open-time
+    # cleanup_stale reaps uncommitted step dirs, including a peer's
+    # in-flight one — the same ordering a real pod gets)
+    cks = [eck.ShardedCheckpointer(
+        str(tmp_path), process_index=pi, process_count=2,
+        use_async=False, commit_timeout_s=0.2,
+        run_meta={"requeue_attempt": 0}) for pi in (0, 1)]
+    for ck in reversed(cks):             # worker 1 lands first
+        ck.save(state, epoch=1, step_in_epoch=0)
+        ck.close()
+    assert eck.latest_manifest(str(tmp_path))["epoch"] == 1
+    # attempt 1 re-reaches the SAME step; only the coordinator has
+    # rewritten — worker 1's index is the dead attempt's leftover
+    ck0 = eck.ShardedCheckpointer(
+        str(tmp_path), process_index=0, process_count=2,
+        use_async=False, commit_timeout_s=0.2,
+        run_meta={"requeue_attempt": 1})
+    ck0.save(state, epoch=2, step_in_epoch=0)
+    assert ck0.commit_failures == 1 and ck0.commits == 0
+    assert eck.latest_manifest(str(tmp_path))["epoch"] == 1, \
+        "stale peer index must not satisfy the new attempt's commit"
+    # worker 1's fresh (attempt-1) write lands -> the commit proceeds
+    ck1 = eck.ShardedCheckpointer(
+        str(tmp_path), process_index=1, process_count=2,
+        use_async=False, run_meta={"requeue_attempt": 1})
+    ck1.save(state, epoch=2, step_in_epoch=0)
+    ck1.close()
+    ck0.save(state, epoch=2, step_in_epoch=0)
+    ck0.close()
+    assert ck0.commits == 1
+    assert eck.latest_manifest(str(tmp_path))["epoch"] == 2
+
+
+def test_grace_kill_rc137_with_stall_record_is_stall(tmp_path):
+    """The `timeout -k` escalation: a wedged run ignores SIGTERM and
+    eats SIGKILL (rc 137) AFTER the watchdog dumped its stall flight
+    record — the policy must classify that as STALL (the requeue path
+    with the stall diagnosis), not a bare preemption and never a
+    crash."""
+    d = tmp_path / "fr"
+    d.mkdir()
+    (d / "flightrec.worker0").write_text(json.dumps(
+        {"reason": "stall", "stall_s": 312.4,
+         "progress": {"phase": "train", "step": 41}}))
+    assert policy.classify(137, flightrec_dir=str(d)) == policy.STALL
+    dec = policy.decide(137, attempt=0, max_requeues=3,
+                        flightrec_dir=str(d))
+    assert dec.verdict == policy.STALL and dec.requeue
+    # without the stall record the same rc stays a plain preemption
+    assert policy.classify(137) == policy.PREEMPTION
+
+
 def test_retention_keeps_last_k_committed(tmp_path, devices8):
     cfg = _cfg(fsdp=2)
     mesh = build_mesh(cfg.parallel, devices=devices8[:2])

@@ -447,6 +447,33 @@ def test_attempts_jsonl_single_success_record(stub_env):
     assert len(recs) == 1 and recs[0]["verdict"] == "success"
 
 
+def test_requeue_backoff_jitter_deterministic_and_bounded(stub_env):
+    """Requeue sleeps carry a bounded deterministic jitter derived from
+    RUN_ID + attempt (cksum), so simultaneous multi-pod requeues after
+    a zone-wide preemption don't stampede re-provisioning: the value is
+    pinned here by recomputing the same formula, and bounded to
+    [0, REQUEUE_JITTER_FRAC * backoff] — which also keeps the
+    REQUEUE_BACKOFF_S=0 drills above sleep-free."""
+    import re
+    env, stub = stub_env
+    env.update(MAX_REQUEUES="2", REQUEUE_BACKOFF_S="0.2",
+               STUB_TRAIN_FAIL_N="1", STUB_TRAIN_RC="137",
+               RUN_ID="jitterpin")
+    r = launch(env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    line = [ln for ln in r.stdout.splitlines() if "jitter" in ln][0]
+    m = re.search(r"after ([0-9.]+)s backoff \+ ([0-9.]+)s jitter", line)
+    assert m, line
+    backoff, jitter = float(m.group(1)), float(m.group(2))
+    assert backoff == 0.2
+    # recompute with the launcher's own formula: cksum("RUN_ID:attempt")
+    h = int(subprocess.run(["cksum"], input=b"jitterpin:0",
+                           capture_output=True).stdout.split()[0])
+    expected = 0.2 * 0.25 * (h % 1000) / 1000
+    assert abs(jitter - expected) < 1e-3, (jitter, expected)
+    assert 0.0 <= jitter <= 0.25 * backoff + 1e-9
+
+
 def test_no_requeue_by_default(stub_env):
     """MAX_REQUEUES defaults to 0: a signal death fails immediately
     (the pre-elastic contract holds unless the operator opts in)."""

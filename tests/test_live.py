@@ -203,11 +203,24 @@ def test_frame_decoder_partial_feeds():
     assert out == [rec] and dec.bad == 0
 
 
+def _framed(raw: bytes) -> bytes:
+    """A well-framed message around arbitrary payload bytes (magic +
+    length + header crc + payload crc) — the sender-side framing,
+    hand-built so the tests can frame non-JSON payloads."""
+    import struct
+    import zlib
+    head = live_lib.FRAME_MAGIC + struct.pack(">I", len(raw))
+    return (head + struct.pack(">I", zlib.crc32(head) & 0xFFFFFFFF)
+            + struct.pack(">I", zlib.crc32(raw) & 0xFFFFFFFF) + raw)
+
+
 def test_frame_decoder_corrupt_length_resyncs():
     dec = live_lib.FrameDecoder()
-    bogus = (live_lib.MAX_FRAME_BYTES + 1).to_bytes(4, "big") + b"junk"
+    bogus = (live_lib.FRAME_MAGIC
+             + (live_lib.MAX_FRAME_BYTES + 1).to_bytes(4, "big")
+             + b"junkjunkjunk")
     assert dec.feed(bogus) == []
-    assert dec.bad == 1
+    assert dec.bad >= 1
     # the decoder recovered: a following good frame still parses
     rec = {"kind": "step", "step": 1}
     assert dec.feed(live_lib.encode_frame(rec)) == [rec]
@@ -215,12 +228,53 @@ def test_frame_decoder_corrupt_length_resyncs():
 
 def test_frame_decoder_bad_payloads_counted():
     dec = live_lib.FrameDecoder()
-    raw = b"not json"
-    assert dec.feed(len(raw).to_bytes(4, "big") + raw) == []
+    assert dec.feed(_framed(b"not json")) == []
     assert dec.bad == 1
-    raw = b"[1, 2]"                   # parses but is not a record
-    assert dec.feed(len(raw).to_bytes(4, "big") + raw) == []
+    assert dec.feed(_framed(b"[1, 2]")) == []   # parses, not a record
     assert dec.bad == 2
+    # well-framed garbage must not desync the stream around it
+    rec = {"kind": "step", "step": 2}
+    assert dec.feed(live_lib.encode_frame(rec)) == [rec]
+
+
+def test_frame_decoder_fuzz_garbage_and_truncation_resync():
+    """The chaos-plane contract (tpudist.chaos telemetry_garbage):
+    seeded random garbage bursts AND truncated frames injected
+    mid-stream must cost only themselves — every intact frame before
+    and after the damage still decodes, in order, and the decoder
+    never wedges. 200 frames, damage before ~half of them."""
+    import random
+    rng = random.Random(7)
+    recs = [{"kind": "step", "step": i, "loss": i / 7.0}
+            for i in range(200)]
+    blob = b""
+    injected = 0
+    for i, r in enumerate(recs):
+        roll = rng.random()
+        if roll < 0.25:
+            # raw garbage burst between frames
+            blob += bytes(rng.randrange(256)
+                          for _ in range(rng.randrange(1, 40)))
+            injected += 1
+        elif roll < 0.45:
+            # a TRUNCATED frame: framing intact, payload cut short —
+            # the crc must reject it and the rescan must recover the
+            # very next intact frame from the swallowed bytes
+            cut = live_lib.encode_frame({"kind": "victim", "i": i})
+            blob += cut[:rng.randrange(5, len(cut) - 1)]
+            injected += 1
+        blob += live_lib.encode_frame(r)
+    dec = live_lib.FrameDecoder()
+    out = []
+    # feed in random-sized chunks: partial reads compose with resync
+    pos = 0
+    while pos < len(blob):
+        n = rng.randrange(1, 200)
+        out += dec.feed(blob[pos:pos + n])
+        pos += n
+    assert injected > 20            # the drill actually injected damage
+    assert [r for r in out if r.get("kind") == "step"] == recs
+    assert dec.bad >= 1
 
 
 def test_parse_endpoint():

@@ -39,5 +39,5 @@ def test_check_registry_covers_both_kernels_and_both_models():
     # the forced-stall flight-recorder drill (CI's observability gate)
     for needle in ("fused_xent", "flash_attention", "long_context", "gqa",
                    "train_step", "moe", "flight_recorder", "autotune",
-                   "devtime"):
+                   "devtime", "chaos"):
         assert needle in joined, f"selfcheck lane lost its {needle} check"

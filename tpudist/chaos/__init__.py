@@ -1,0 +1,34 @@
+"""tpudist.chaos — deterministic fault injection across the pod stack.
+
+The detect-and-recover machinery (watchdog, alerts, elastic resume,
+requeue policy, goodput ledger) is only believable if the recovery
+paths are exercised, not just the detection. This package is the drill
+plane that exercises them, in four pieces:
+
+  * :mod:`plan`   — the seeded fault schedule (``--chaos``/
+    ``TPUDIST_CHAOS`` spec → :class:`~tpudist.chaos.plan.ChaosPlan`);
+    seven fault families: hard kill, hang, slow-host straggler,
+    checkpoint-shard corruption/truncation, torn manifest, transient
+    filesystem errors, garbage on the live-telemetry stream;
+  * :mod:`inject` — :class:`~tpudist.chaos.inject.ChaosRuntime`, the
+    injection engine the train loop and the sharded-checkpoint writer
+    call into;
+  * :mod:`drill`  — the jax-free matrix driver: runs the REAL train CLI
+    in subprocesses under each family (kill → policy → requeue →
+    resume, exactly the launcher's loop), writing ``attempts.jsonl``
+    like ``launch_tpu.sh`` would;
+  * :mod:`verify` — the jax-free invariant checker: replays a drill's
+    artifacts and asserts the contract end to end (policy classified
+    the fault right, resume came back from the newest COMMITTED step —
+    bitwise on an unchanged mesh, by shard-index crc — the goodput
+    partition stayed exact, and every fail verdict had its matching
+    mid-run alert).
+
+``python -m tpudist.chaos drill|verify`` is the CLI; ``tpudist.selfcheck
+check_chaos`` runs the whole matrix as an acceptance gate.
+"""
+
+from tpudist.chaos.inject import ChaosRuntime
+from tpudist.chaos.plan import ChaosPlan, FaultEvent, FAULT_KINDS
+
+__all__ = ["ChaosPlan", "ChaosRuntime", "FaultEvent", "FAULT_KINDS"]

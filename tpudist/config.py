@@ -112,6 +112,12 @@ class TrainConfig:
     pp_microbatches: int = 0      # pipeline microbatches (0 = pipe size)
     cp_impl: str = "ring"         # context parallelism: ring | ulysses
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
+    chaos: Optional[str] = None   # scripted fault-injection plan
+    # (tpudist.chaos): ";"-separated <fault>@<epoch>:<step>[:<rank>]
+    # [,k=v...] events — kill | hang | slow | corrupt_shard |
+    # torn_manifest | fs_error | telemetry_garbage. None =
+    # $TPUDIST_CHAOS, else off (resolve_chaos). Deterministic by
+    # construction: the same spec replays the same faults
     log_every: int = 100
     profile_dir: Optional[str] = None  # write jax.profiler traces here
     profile_window: int = 0       # capture N mid-run supersteps with
@@ -396,6 +402,15 @@ def resolve_resume(cfg: TrainConfig) -> Optional[str]:
     return r
 
 
+def resolve_chaos(cfg: TrainConfig) -> Optional[str]:
+    """Resolve ``--chaos`` / ``TPUDIST_CHAOS`` to the raw fault-plan
+    spec, or None (the default: no chaos plane constructed, zero hooks
+    installed). The spec itself is parsed — and validated loudly — by
+    ``tpudist.chaos.ChaosPlan.parse`` at run start, not here: config
+    must stay importable without the chaos package resolved."""
+    return cfg.chaos or os.environ.get("TPUDIST_CHAOS") or None
+
+
 def resolve_requeue_attempt(cfg: TrainConfig) -> int:
     """Which auto-requeue rerun this is: explicit flag, else
     ``TPUDIST_REQUEUE_ATTEMPT``, else 0."""
@@ -632,6 +647,14 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--fail-at", type=int, default=None,
                    help="fault injection: fail after this epoch (replaces the "
                         "reference's commented-out sys.exit(1), train.py:129)")
+    p.add_argument("--chaos", type=str, default=None,
+                   help="scripted fault-injection plan (tpudist.chaos): "
+                        "';'-separated <fault>@<epoch>:<step>[:<rank>]"
+                        "[,k=v...] events, fault one of kill | hang | "
+                        "slow | corrupt_shard | torn_manifest | fs_error "
+                        "| telemetry_garbage — e.g. "
+                        "'corrupt_shard@0:6,mode=flip;kill@0:7' "
+                        "(default: $TPUDIST_CHAOS, else off)")
     p.add_argument("--log-every", type=int, default=100)
     p.add_argument("--steps-per-dispatch", type=int, default=0,
                    help="superstep length: compile k train steps into one "
@@ -751,6 +774,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         pp_microbatches=args.pp_microbatches,
         cp_impl=args.cp_impl,
         fail_at=args.fail_at,
+        chaos=args.chaos,
         log_every=args.log_every,
         profile_dir=args.profile_dir,
         profile_window=args.profile_window,

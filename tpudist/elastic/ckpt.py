@@ -45,7 +45,8 @@ import shutil
 import sys
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+import zlib
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -56,6 +57,33 @@ DEFAULT_KEEP = 3
 # Generous by default — a slow NFS worker must not lose a checkpoint —
 # and shrunk by tests via the env override.
 COMMIT_TIMEOUT_S = 300.0
+# Transient-filesystem-error policy for the shard writer: a flaky NFS
+# EIO / momentary ENOSPC must cost a retry, not a checkpoint — and
+# exhaustion must cost THAT STEP'S commit, never a wedged writer thread
+# or a dead training run (the previous manifest stays authoritative).
+# Env overrides TPUDIST_CKPT_RETRIES / TPUDIST_CKPT_RETRY_BACKOFF_S.
+WRITE_RETRIES = 3
+WRITE_RETRY_BACKOFF_S = 0.05
+
+# ---------------------------------------------------- chaos fault hook
+# The chaos plane (tpudist.chaos) injects write-path faults through this
+# module-level hook: called at named points of ShardedCheckpointer._write
+# with the save's step context. A hook may raise OSError (a scripted
+# transient fs error — the retry loop above absorbs it), damage the
+# just-landed file (shard corruption — restore's crc check must catch
+# it), or os._exit (the torn-manifest kill between index land and
+# commit). None (the default) costs one attribute read per point.
+_FAULT_HOOK: Optional[Callable[..., None]] = None
+
+
+def set_fault_hook(hook: Optional[Callable[..., None]]) -> None:
+    global _FAULT_HOOK
+    _FAULT_HOOK = hook
+
+
+def _fault(point: str, **ctx: Any) -> None:
+    if _FAULT_HOOK is not None:
+        _FAULT_HOOK(point, **ctx)
 
 
 def elastic_root(save_dir: str) -> str:
@@ -101,6 +129,45 @@ def latest_manifest(save_dir: str) -> Optional[Dict[str, Any]]:
         return None
     with open(path) as f:
         return json.load(f)
+
+
+def committed_manifests(save_dir: str) -> List[Dict[str, Any]]:
+    """Every committed manifest still on disk, NEWEST FIRST: the
+    top-level ``manifest.json`` plus the per-step copies each commit
+    leaves inside its step directory. The per-step copies are what
+    restore falls back onto when the newest checkpoint's shards fail
+    their crc check — without them a corrupt byte would cost ALL the
+    retained history, not one step. Steps newer than the top-level
+    manifest are ignored (a per-step copy whose top-level flip a kill
+    tore off is not committed; :func:`cleanup_stale` reaps its dir),
+    and checkpoints predating the copies simply have no fallback."""
+    latest = latest_manifest(save_dir)
+    if latest is None:
+        return []
+    out = [latest]
+    seen = {int(latest["step"])}
+    sdir = _steps_dir(elastic_root(save_dir))
+    if not os.path.isdir(sdir):
+        return out
+    for name in sorted(os.listdir(sdir), reverse=True):
+        if not name.isdigit():
+            continue
+        step = int(name)
+        if step in seen or step > int(latest["step"]):
+            continue
+        p = os.path.join(sdir, name, "manifest.json")
+        if not os.path.exists(p):
+            continue          # retained but never committed (or too old)
+        try:
+            with open(p) as f:
+                man = json.load(f)
+        except (OSError, ValueError):
+            continue          # a torn copy is not a fallback
+        if int(man.get("step", -1)) != step:
+            continue
+        out.append(man)
+        seen.add(step)
+    return out
 
 
 def state_leaves(state: Any) -> List[Tuple[str, Any]]:
@@ -172,6 +239,16 @@ class ShardedCheckpointer:
         self.keep = keep
         self.use_async = use_async
         self.run_meta = dict(run_meta or {})
+        # the commit rendezvous' freshness key: a corruption-FALLBACK
+        # resume re-reaches steps whose dir still holds the dead
+        # attempt's indexes (the dir was committed, so cleanup_stale
+        # leaves it), and a commit satisfied by a peer's STALE index
+        # would flip the manifest onto the very bytes the fallback
+        # rejected — indexes therefore stamp the attempt they were
+        # written by, and the rendezvous only counts this attempt's
+        # (None = unstamped callers/old indexes keep the old behavior)
+        att = self.run_meta.get("requeue_attempt")
+        self._attempt = int(att) if isinstance(att, (int, float)) else None
         if commit_timeout_s is None:
             try:
                 commit_timeout_s = float(os.environ.get(
@@ -179,6 +256,16 @@ class ShardedCheckpointer:
             except ValueError:
                 commit_timeout_s = COMMIT_TIMEOUT_S
         self.commit_timeout_s = commit_timeout_s
+        try:
+            self.write_retries_max = int(os.environ.get(
+                "TPUDIST_CKPT_RETRIES", WRITE_RETRIES))
+        except ValueError:
+            self.write_retries_max = WRITE_RETRIES
+        try:
+            self.write_retry_backoff_s = float(os.environ.get(
+                "TPUDIST_CKPT_RETRY_BACKOFF_S", WRITE_RETRY_BACKOFF_S))
+        except ValueError:
+            self.write_retry_backoff_s = WRITE_RETRY_BACKOFF_S
         self.last_enqueue_ms: float = 0.0
         self.last_drain_ms: float = 0.0
         self.drain_ms: float = 0.0
@@ -186,6 +273,12 @@ class ShardedCheckpointer:
         self.commits: int = 0           # manifests this process committed
         self.commit_failures: int = 0   # commit waits that timed out
         self.write_errors: int = 0
+        self.write_retries: int = 0     # transient fs errors retried away
+        self.write_skips: int = 0       # saves abandoned after exhaustion
+        # steps whose shard write was abandoned: the coordinator must
+        # not sit out the full commit timeout waiting for shards that
+        # will never land — that step's commit is skipped outright
+        self._skip_commit_steps: set = set()
         # reap the dead run's tmp files / uncommitted step dirs BEFORE
         # the first save can collide with a half-written leftover
         cleanup_stale(save_dir, process_index=self.process_index)
@@ -223,9 +316,17 @@ class ShardedCheckpointer:
                         shd.owned_shard_spans(leaf, self.process_index)):
                     key = f"L{li}_S{si}"
                     arrays[key] = data
+                    # crc32 of the shard's raw bytes, recorded BEFORE
+                    # any file I/O: restore verifies it against what
+                    # the filesystem hands back, so a corrupt or
+                    # truncated shard is detected — and the manifest
+                    # rejected in favor of the previous committed step
+                    # — instead of resuming from garbage
                     shards.append({"key": key,
                                    "start": [s for s, _ in span],
-                                   "shape": list(data.shape)})
+                                   "shape": list(data.shape),
+                                   "crc32": zlib.crc32(data.tobytes())
+                                   & 0xFFFFFFFF})
                 index[name] = {
                     "shape": list(getattr(leaf, "shape", ())),
                     "dtype": str(np.dtype(getattr(leaf, "dtype",
@@ -237,8 +338,10 @@ class ShardedCheckpointer:
                 if self.process_index == 0:
                     self._q.put(("commit", job[:3]))
             else:
-                self._write(*job)
-                if self.process_index == 0:
+                # sync mode shares the retry/skip discipline: a
+                # transient fs error exhausting its retries skips this
+                # step's commit instead of killing the training run
+                if self._write_retrying(*job) and self.process_index == 0:
                     self._commit(step, int(epoch), int(step_in_epoch))
         self.last_enqueue_ms = (time.perf_counter() - t0) * 1000
         self.saves += 1
@@ -251,7 +354,7 @@ class ShardedCheckpointer:
                 if kind == "stop":
                     return
                 elif kind == "write":
-                    self._write(*payload)
+                    self._write_retrying(*payload)
                 elif kind == "commit":
                     self._commit(*payload)
             except Exception as e:
@@ -264,22 +367,59 @@ class ShardedCheckpointer:
             finally:
                 self._q.task_done()
 
+    def _write_retrying(self, step: int, epoch: int, step_in_epoch: int,
+                        index: Dict[str, Any],
+                        arrays: Dict[str, np.ndarray]) -> bool:
+        """Bounded retry-with-backoff around the shard write: transient
+        filesystem errors (a flaky NFS EIO, momentary ENOSPC) retry;
+        exhaustion skips THIS STEP's commit — the writer thread never
+        wedges and the previous manifest stays authoritative. Non-OSError
+        failures keep their old path (sync raises, async is caught by
+        the worker loop's generic handler)."""
+        delay = self.write_retry_backoff_s
+        for attempt in range(self.write_retries_max + 1):
+            try:
+                self._write(step, epoch, step_in_epoch, index, arrays)
+                return True
+            except OSError as e:
+                if attempt >= self.write_retries_max:
+                    self.write_errors += 1
+                    self.write_skips += 1
+                    self._skip_commit_steps.add(step)
+                    print(f"tpudist: sharded ckpt write of step {step} "
+                          f"failed {attempt + 1}x ({e!r}); skipping this "
+                          f"step's commit — the previous manifest stays "
+                          f"committed", file=sys.stderr, flush=True)
+                    return False
+                self.write_retries += 1
+                time.sleep(delay)
+                delay *= 2
+        return False
+
     def _write(self, step: int, epoch: int, step_in_epoch: int,
                index: Dict[str, Any], arrays: Dict[str, np.ndarray]
                ) -> None:
         d = step_dir(self.root, step)
         os.makedirs(d, exist_ok=True)
         npz = os.path.join(d, shards_name(self.process_index))
+        _fault("shard_write", step=step, epoch=epoch,
+               step_in_epoch=step_in_epoch, path=npz)
         tmp = f"{npz}.tmp"
         with open(tmp, "wb") as f:
             np.savez(f, **arrays)
         os.replace(tmp, npz)
+        _fault("shard_written", step=step, epoch=epoch,
+               step_in_epoch=step_in_epoch, path=npz)
         # the index lands LAST: its presence is this worker's "shards
         # landed" marker — the commit's filesystem rendezvous
-        _atomic_json(os.path.join(d, index_name(self.process_index)), {
+        ipath = os.path.join(d, index_name(self.process_index))
+        _atomic_json(ipath, {
             "schema": MANIFEST_SCHEMA_VERSION, "step": step,
             "epoch": epoch, "step_in_epoch": step_in_epoch,
-            "process_index": self.process_index, "leaves": index})
+            "process_index": self.process_index,
+            "requeue_attempt": self._attempt, "leaves": index})
+        _fault("index_written", step=step, epoch=epoch,
+               step_in_epoch=step_in_epoch, path=ipath)
 
     # --------------------------------------------------------- commit
     def _worker_landed(self, step: int, i: int) -> bool:
@@ -288,9 +428,19 @@ class ShardedCheckpointer:
             return False
         try:
             with open(p) as f:
-                return int(json.load(f).get("step", -1)) == step
+                idx = json.load(f)
         except (ValueError, OSError):
             return False
+        if int(idx.get("step", -1)) != step:
+            return False
+        # freshness: a previous attempt's leftover index in a re-reached
+        # step dir must not satisfy THIS attempt's rendezvous — wait for
+        # the peer to rewrite (unstamped indexes keep the old behavior)
+        stamped = idx.get("requeue_attempt")
+        if self._attempt is not None and stamped is not None \
+                and int(stamped) != self._attempt:
+            return False
+        return True
 
     def _landed(self, step: int, verified: Optional[set] = None) -> bool:
         """All workers' shard indexes landed for ``step``. ``verified``
@@ -313,7 +463,17 @@ class ShardedCheckpointer:
         """Coordinator only: wait (bounded) for every worker's shard
         index, then atomically flip ``manifest.json`` to this step and
         apply retention. On timeout the previous manifest simply stays
-        authoritative — never a partial commit."""
+        authoritative — never a partial commit. A per-step copy of the
+        manifest lands inside the step dir FIRST: that copy is what
+        restore falls back onto when a newer checkpoint's shards fail
+        their crc check (it only becomes meaningful once the top-level
+        flip succeeds, so a kill between the two writes changes
+        nothing)."""
+        if step in self._skip_commit_steps:
+            # this worker's own shard write was abandoned after retry
+            # exhaustion: the rendezvous can never complete — don't sit
+            # out the full timeout on a commit that must not happen
+            return
         deadline = time.monotonic() + self.commit_timeout_s
         verified: set = set()
         while not self._landed(step, verified):
@@ -329,12 +489,15 @@ class ShardedCheckpointer:
                                index_name(0))) as f:
             leaves = {name: {"shape": rec["shape"], "dtype": rec["dtype"]}
                       for name, rec in json.load(f)["leaves"].items()}
-        _atomic_json(manifest_path(self.save_dir), {
+        payload = {
             "schema": MANIFEST_SCHEMA_VERSION,
             "step": step, "epoch": epoch, "step_in_epoch": step_in_epoch,
             "process_count": self.process_count,
             "ts": time.time(), "run": self.run_meta, "leaves": leaves,
-            "dir": os.path.relpath(step_dir(self.root, step), self.root)})
+            "dir": os.path.relpath(step_dir(self.root, step), self.root)}
+        _atomic_json(os.path.join(step_dir(self.root, step),
+                                  "manifest.json"), payload)
+        _atomic_json(manifest_path(self.save_dir), payload)
         self.commits += 1
         self._retain(step)
 

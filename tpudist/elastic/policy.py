@@ -157,7 +157,15 @@ def classify(rc: int, *, flightrec_dir: Optional[str] = None,
     if rc == 124 or "stall" in reasons:
         # the outer timeout or the in-process watchdog saw a hang: the
         # classic shape of a peer preempted mid-collective — the
-        # survivors wedge, the watchdog dumps, the launcher kills
+        # survivors wedge, the watchdog dumps, the launcher kills.
+        # This check runs BEFORE the bare-signal table below on
+        # purpose: `timeout -k` escalates SIGTERM→SIGKILL, so a wedged
+        # run that ignores the grace signal exits 137 — with the
+        # watchdog's stall dump in evidence that is STILL a stall (the
+        # requeue path with the stall diagnosis attached), never a
+        # crash and not a plain preemption; the signal-rc fallback only
+        # applies when no stall dump landed (pinned in
+        # tests/test_elastic.py)
         return STALL
     if rc in _SIGNAL_RCS:
         return PREEMPTION

@@ -27,6 +27,8 @@ from __future__ import annotations
 
 import json
 import os
+import sys
+import zlib
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -41,31 +43,87 @@ class ResumeError(ValueError):
     ``fail`` resume_status; ``--resume latest`` lets it propagate."""
 
 
+class ShardCorruptionError(ResumeError):
+    """A committed checkpoint's BYTES are bad: a shard file unreadable
+    or truncated, a shard failing its recorded crc32, a missing shard
+    index. Distinct from the structural :class:`ResumeError`s because
+    the right response differs: a corrupt checkpoint falls back to the
+    PREVIOUS committed manifest (flagged ``fallback_from``/
+    ``corrupt_shard`` in ``kind=resume``) — losing one checkpoint
+    interval of steps — instead of raising or fresh-starting, while a
+    structure/cursor mismatch must refuse loudly (an older checkpoint
+    would mismatch the same way)."""
+
+
 def _shard_table(save_dir: str, manifest: Dict[str, Any]):
     """Per-leaf shard lists from every worker's index:
-    ``name -> [(start, shape, npz, key), ...]`` plus the open npz
-    handles (lazy per-key loads; caller closes)."""
+    ``name -> [(start, shape, npz, key, crc32), ...]`` plus the open
+    npz handles (lazy per-key loads; caller closes). Unreadable shard
+    files and missing/torn indexes raise :class:`ShardCorruptionError`
+    — fallback-eligible, unlike structural mismatches."""
     root = ckpt_mod.elastic_root(save_dir)
     d = os.path.join(root, manifest["dir"])
     table: Dict[str, List[Tuple]] = {}
     handles = []
-    for i in range(int(manifest["process_count"])):
-        ipath = os.path.join(d, ckpt_mod.index_name(i))
-        if not os.path.exists(ipath):
-            raise ResumeError(
-                f"committed manifest step {manifest['step']} is missing "
-                f"worker {i}'s shard index ({ipath}) — was the steps/ "
-                f"directory pruned by hand?")
-        with open(ipath) as f:
-            idx = json.load(f)
-        npz = np.load(os.path.join(d, ckpt_mod.shards_name(i)))
-        handles.append(npz)
-        for name, rec in idx["leaves"].items():
-            rows = table.setdefault(name, [])
-            for sh in rec["shards"]:
-                rows.append((tuple(sh["start"]), tuple(sh["shape"]),
-                             npz, sh["key"]))
+    try:
+        for i in range(int(manifest["process_count"])):
+            ipath = os.path.join(d, ckpt_mod.index_name(i))
+            if not os.path.exists(ipath):
+                raise ShardCorruptionError(
+                    f"committed manifest step {manifest['step']} is "
+                    f"missing worker {i}'s shard index ({ipath}) — torn "
+                    f"tree or hand-pruned steps/ directory")
+            try:
+                with open(ipath) as f:
+                    idx = json.load(f)
+            except (OSError, ValueError) as e:
+                raise ShardCorruptionError(
+                    f"worker {i}'s shard index {ipath} is unreadable "
+                    f"({e!r})")
+            spath = os.path.join(d, ckpt_mod.shards_name(i))
+            try:
+                npz = np.load(spath)
+            except Exception as e:
+                # a truncated npz is a broken zip: np.load raises
+                # anything from BadZipFile to OSError depending on
+                # where the cut landed
+                raise ShardCorruptionError(
+                    f"worker {i}'s shard file {spath} is unreadable "
+                    f"({e!r}) — corrupt or truncated")
+            handles.append(npz)
+            for name, rec in idx["leaves"].items():
+                rows = table.setdefault(name, [])
+                for sh in rec["shards"]:
+                    rows.append((tuple(sh["start"]), tuple(sh["shape"]),
+                                 npz, sh["key"], sh.get("crc32")))
+    except Exception:
+        for h in handles:
+            try:
+                h.close()
+            except Exception:
+                pass
+        raise
     return table, handles
+
+
+def _shard_data(npz, key: str, crc: Optional[int]) -> np.ndarray:
+    """One shard's bytes off disk, verified against the crc32 the
+    writer recorded from the in-memory array — the check that turns a
+    bit flip or short read into a detected :class:`ShardCorruptionError`
+    instead of silently-wrong resumed weights. Older indexes without a
+    crc restore unverified (the pre-crc behavior)."""
+    try:
+        arr = np.asarray(npz[key])
+    except Exception as e:
+        raise ShardCorruptionError(
+            f"shard {key} is unreadable ({e!r}) — corrupt or truncated "
+            f"shard file")
+    if crc is not None and (zlib.crc32(arr.tobytes()) & 0xFFFFFFFF) \
+            != int(crc):
+        raise ShardCorruptionError(
+            f"shard {key} failed its crc32 check — the bytes on disk "
+            f"are not the bytes the checkpoint wrote")
+    return arr
 
 
 def _as_dtype(arr: np.ndarray, dtype: np.dtype) -> np.ndarray:
@@ -89,21 +147,22 @@ def _assemble(region: Tuple[Tuple[int, int], ...], shards, dtype
     intersect it — the per-leaf slice-assembly reshard. Exact-match
     shards return zero-copy; anything else is gathered piecewise with
     full-coverage checking (a hole means the manifest does not actually
-    tile the array — refuse rather than resume from garbage)."""
+    tile the array — refuse rather than resume from garbage). Every
+    shard read is crc-verified (:func:`_shard_data`)."""
     shape = tuple(stop - start for start, stop in region)
-    for start, sshape, npz, key in shards:
+    for start, sshape, npz, key, crc in shards:
         if (tuple((s, s + d) for s, d in zip(start, sshape)) == region):
-            return _as_dtype(npz[key], dtype)
+            return _as_dtype(_shard_data(npz, key, crc), dtype)
     out = np.zeros(shape, dtype=dtype)
     filled = 0
-    for start, sshape, npz, key in shards:
+    for start, sshape, npz, key, crc in shards:
         # intersection of [start, start+sshape) with the region
         lo = [max(s, r0) for s, (r0, _) in zip(start, region)]
         hi = [min(s + d, r1) for s, d, (_, r1)
               in zip(start, sshape, region)]
         if any(l >= h for l, h in zip(lo, hi)):
             continue
-        src = _as_dtype(npz[key], dtype)[
+        src = _as_dtype(_shard_data(npz, key, crc), dtype)[
             tuple(slice(l - s, h - s)
                   for l, h, s in zip(lo, hi, start))]
         out[tuple(slice(l - r0, h - r0)
@@ -142,20 +201,56 @@ def validate_run_meta(manifest: Dict[str, Any],
 
 
 def restore(save_dir: str, template: Any, *,
-            run_meta: Optional[Dict[str, Any]] = None
+            run_meta: Optional[Dict[str, Any]] = None,
+            details: Optional[Dict[str, Any]] = None
             ) -> Optional[Tuple[Any, int, int]]:
-    """Restore the committed sharded manifest onto ``template``'s mesh
-    layout as ``(state, epoch, step_in_epoch)``, or None when no
-    manifest was ever committed. ``template`` (the concretely-sharded
-    live TrainState) pins the treedef, shapes, dtypes and target
-    shardings; the saved shards may come from any process/device
-    count."""
+    """Restore the newest RESTORABLE committed manifest onto
+    ``template``'s mesh layout as ``(state, epoch, step_in_epoch)``, or
+    None when no manifest was ever committed. ``template`` (the
+    concretely-sharded live TrainState) pins the treedef, shapes,
+    dtypes and target shardings; the saved shards may come from any
+    process/device count.
+
+    A checkpoint whose BYTES are bad (crc mismatch, truncated shard
+    file, torn index — :class:`ShardCorruptionError`) is skipped and
+    the previous committed manifest restores instead: a bit flip must
+    cost one checkpoint interval, not the whole run. When a ``details``
+    dict is passed, a fallback populates ``details["fallback_from"]``
+    (the corrupt step) and ``details["corrupt_shard"]`` (what failed) —
+    the train loop flags both in its ``kind=resume`` record. Structural
+    failures (shape/dtype/cursor mismatch) still raise immediately: an
+    older checkpoint would mismatch identically, so falling back would
+    only hide the real problem. Every committed manifest corrupt ⇒ the
+    newest one's error propagates (``--resume auto`` then degrades to a
+    flagged fresh start)."""
+    manifests = ckpt_mod.committed_manifests(save_dir)
+    if not manifests:
+        return None
+    first_corrupt: Optional[Tuple[int, Exception]] = None
+    for man in manifests:
+        validate_run_meta(man, run_meta)
+        try:
+            out = _restore_manifest(save_dir, man, template)
+        except ShardCorruptionError as e:
+            print(f"tpudist: elastic restore: committed step "
+                  f"{man['step']} is corrupt ({e}); falling back to "
+                  f"the previous committed manifest",
+                  file=sys.stderr, flush=True)
+            if first_corrupt is None:
+                first_corrupt = (int(man["step"]), e)
+            continue
+        if first_corrupt is not None and details is not None:
+            details["fallback_from"] = first_corrupt[0]
+            details["corrupt_shard"] = str(first_corrupt[1])
+        return out
+    raise first_corrupt[1]
+
+
+def _restore_manifest(save_dir: str, manifest: Dict[str, Any],
+                      template: Any) -> Tuple[Any, int, int]:
+    """One manifest's restore proper (the pre-fallback body)."""
     import jax
 
-    manifest = ckpt_mod.latest_manifest(save_dir)
-    if manifest is None:
-        return None
-    validate_run_meta(manifest, run_meta)
     table, handles = _shard_table(save_dir, manifest)
     try:
         flat, treedef = jax.tree_util.tree_flatten_with_path(template)
@@ -203,7 +298,8 @@ def restore(save_dir: str, template: Any, *,
 
 
 def restore_for_resume(save_dir: str, template: Any, *,
-                       run_meta: Optional[Dict[str, Any]] = None
+                       run_meta: Optional[Dict[str, Any]] = None,
+                       details: Optional[Dict[str, Any]] = None
                        ) -> Optional[Tuple[Any, int, int, str]]:
     """The train loop's one resume entry. The elastic tree and orbax
     step dirs can coexist in one ``--save-dir`` (e.g. a run switched
@@ -224,14 +320,14 @@ def restore_for_resume(save_dir: str, template: Any, *,
     if manifest is not None and (orbax_step is None
                                  or int(manifest["step"]) >= orbax_step):
         try:
-            out = restore(save_dir, template, run_meta=run_meta)
+            out = restore(save_dir, template, run_meta=run_meta,
+                          details=details)
             if out is not None:
                 return (*out, "manifest")
         except Exception as e:
             if orbax_step is None:
                 raise
             manifest_err = e
-            import sys
             print(f"tpudist: elastic manifest restore failed ({e!r}); "
                   f"falling back to the orbax checkpoint at step "
                   f"{orbax_step}", file=sys.stderr, flush=True)
@@ -241,7 +337,8 @@ def restore_for_resume(save_dir: str, template: Any, *,
     if manifest is not None and manifest_err is None:
         # manifest is older than an orbax key that then failed to
         # restore (or vanished between peek and read): still usable
-        out = restore(save_dir, template, run_meta=run_meta)
+        out = restore(save_dir, template, run_meta=run_meta,
+                      details=details)
         if out is not None:
             return (*out, "manifest")
     return None

@@ -301,8 +301,8 @@ def _beacon_progress(beacons: Optional[Dict[int, Dict[str, Any]]]
 
 
 def _dead_into(buckets: Dict[str, float], recs, *, first_ts,
-               next_resume, beacons, first_attempt: bool
-               ) -> Dict[str, Any]:
+               next_resume, beacons, first_attempt: bool,
+               wall: float = float("inf")) -> Dict[str, Any]:
     """Bucket a KILLED attempt from what survived: flushed step/ckpt
     records give the rate and committed progress, the final beacon the
     true progress, the resuming attempt's record what was kept.
@@ -367,8 +367,16 @@ def _dead_into(buckets: Dict[str, float], recs, *, first_ts,
             n1 = max(0, int(steps[0].get("step") or 0) - g0)
             if isinstance(t1, (int, float)):
                 est = (float(t1) - first_ts) - n1 / sps
+                # the estimate is a timestamp inference, and inferring
+                # MORE than the attempt's unaccounted wall is by
+                # definition overcounting — clamp to the remaining
+                # headroom, so estimator noise on a dead attempt cannot
+                # flag the partition inexact (measured buckets keep
+                # their own double-counting check)
+                est = min(max(0.0, est),
+                          max(0.0, wall - sum(buckets.values())))
                 buckets["compile" if first_attempt
-                        else "rewarmup"] += max(0.0, est)
+                        else "rewarmup"] += est
     return {"steps_done": steps_done, "lost_steps": lost,
             "lost_steps_beacon": lost_beacon,
             "beacon_step": b_step,
@@ -460,6 +468,10 @@ def build_ledger(attempts: List[Dict[str, Any]],
         timings = _kind(recs, "timing")
         next_resumes = [r for r in by_att.get(att + 1, [])
                         if r.get("kind") == "resume"]
+        # measured ckpt/eval land FIRST so the dead-attempt estimator
+        # sees the true remaining headroom when it clamps
+        buckets["ckpt"] += _ckpt_seconds(recs)
+        buckets["eval"] += _eval_seconds(recs)
         info: Dict[str, Any] = {}
         if timings:
             info = _completed_into(buckets, recs, timings[-1],
@@ -468,9 +480,8 @@ def build_ledger(attempts: List[Dict[str, Any]],
             info = _dead_into(
                 buckets, recs, first_ts=first_ts,
                 next_resume=next_resumes[-1] if next_resumes else None,
-                beacons=beacons.get(att), first_attempt=(i == 0))
-        buckets["ckpt"] += _ckpt_seconds(recs)
-        buckets["eval"] += _eval_seconds(recs)
+                beacons=beacons.get(att), first_attempt=(i == 0),
+                wall=wall)
         measured = sum(v for k, v in buckets.items() if k != "residue")
         buckets["residue"] = wall - measured
         if buckets["residue"] < -tolerance * max(wall, 1e-9):

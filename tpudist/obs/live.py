@@ -70,40 +70,106 @@ MAX_FRAME_BYTES = 1 << 20
 # ------------------------------------------------------------ wire format
 
 _LEN = struct.Struct(">I")
+_CRC = struct.Struct(">I")
+# Frame marker: the decoder's resynchronisation anchor. A naked length
+# prefix cannot recover from garbage on the stream (any 4 bytes read as
+# a length), so each frame leads with this magic and carries a payload
+# crc32 — garbage between frames is skipped by scanning to the next
+# marker, and a frame whose bytes were torn mid-stream (truncation, a
+# chance marker inside garbage) fails its crc and costs ONLY itself:
+# the decoder rescans the very bytes it tentatively consumed, so
+# buffered and subsequent valid frames still decode (pinned by the
+# fuzz test in tests/test_live.py — the chaos plane's
+# telemetry_garbage drill injects exactly this).
+FRAME_MAGIC = b"TPLF"
+# header layout: magic + payload length + header crc32 (over the magic
+# and length bytes — a TORN length field is rejected the moment the
+# header arrives, instead of stalling the stream on a phantom payload
+# that never comes) + payload crc32
+_HEADER = len(FRAME_MAGIC) + _LEN.size + 2 * _CRC.size
 
 
 def encode_frame(rec: Dict[str, Any]) -> bytes:
-    """One record as a length-prefixed JSON frame (4-byte big-endian
-    length + UTF-8 payload). The same framing rides TCP streams and UDP
-    datagrams, so both transports share one codec."""
+    """One record as a framed JSON message: 4-byte magic + big-endian
+    payload length + header crc32 + payload crc32 + UTF-8 payload. The
+    same framing rides TCP streams and UDP datagrams, so both
+    transports share one codec."""
+    import zlib
     payload = json.dumps(rec, separators=(",", ":"),
                          default=str).encode("utf-8")
-    return _LEN.pack(len(payload)) + payload
+    head = FRAME_MAGIC + _LEN.pack(len(payload))
+    return (head + _CRC.pack(zlib.crc32(head) & 0xFFFFFFFF)
+            + _CRC.pack(zlib.crc32(payload) & 0xFFFFFFFF) + payload)
 
 
 class FrameDecoder:
-    """Incremental frame parser for one TCP connection (or one UDP
-    datagram). Tolerates partial reads; a corrupt length prefix or
-    unparseable payload bumps ``bad`` and resynchronises rather than
-    wedging the aggregator on one bad peer."""
+    """Incremental, SELF-RESYNCHRONISING frame parser for one TCP
+    connection (or one UDP datagram). Tolerates partial reads; garbage
+    bytes, corrupt length prefixes and torn frames bump ``bad`` and the
+    decoder scans forward to the next frame marker — one bad peer (or a
+    chaos-injected garbage burst) can neither wedge the aggregator nor
+    cost the valid frames around the damage."""
 
     def __init__(self) -> None:
         self._buf = b""
         self.bad = 0
 
+    def _discard_to_marker(self) -> bool:
+        """Drop bytes that cannot start a frame; keep a possible marker
+        prefix at the tail. True when a full marker heads the buffer."""
+        i = self._buf.find(FRAME_MAGIC)
+        if i == 0:
+            return True
+        if i > 0:
+            self.bad += 1             # garbage before the marker
+            self._buf = self._buf[i:]
+            return True
+        keep = 0
+        for k in range(min(len(FRAME_MAGIC) - 1, len(self._buf)), 0, -1):
+            if self._buf.endswith(FRAME_MAGIC[:k]):
+                keep = k
+                break
+        if len(self._buf) > keep:
+            self.bad += 1             # pure garbage discarded
+            self._buf = self._buf[len(self._buf) - keep:] if keep else b""
+        return False
+
     def feed(self, data: bytes) -> List[Dict[str, Any]]:
+        import zlib
         self._buf += data
         out: List[Dict[str, Any]] = []
-        while len(self._buf) >= _LEN.size:
-            (n,) = _LEN.unpack_from(self._buf)
-            if n > MAX_FRAME_BYTES:
+        while self._buf:
+            if not self._discard_to_marker():
+                break                 # no full marker buffered yet
+            if len(self._buf) < _HEADER:
+                break
+            (n,) = _LEN.unpack_from(self._buf, len(FRAME_MAGIC))
+            (hcrc,) = _CRC.unpack_from(self._buf,
+                                       len(FRAME_MAGIC) + _LEN.size)
+            head = self._buf[:len(FRAME_MAGIC) + _LEN.size]
+            if n > MAX_FRAME_BYTES \
+                    or zlib.crc32(head) & 0xFFFFFFFF != hcrc:
+                # torn/corrupt header (or a chance marker inside
+                # garbage): reject NOW — waiting out a phantom length
+                # would stall the stream — skip just this marker and
+                # rescan what follows
                 self.bad += 1
-                self._buf = b""
-                break
-            if len(self._buf) < _LEN.size + n:
-                break
-            raw = self._buf[_LEN.size:_LEN.size + n]
-            self._buf = self._buf[_LEN.size + n:]
+                self._buf = self._buf[1:]
+                continue
+            if len(self._buf) < _HEADER + n:
+                break                 # wait for the rest of the frame
+            (crc,) = _CRC.unpack_from(
+                self._buf, len(FRAME_MAGIC) + _LEN.size + _CRC.size)
+            raw = self._buf[_HEADER:_HEADER + n]
+            if zlib.crc32(raw) & 0xFFFFFFFF != crc:
+                # torn frame (truncated sender, garbage with a chance
+                # marker): the bytes we tentatively framed may CONTAIN
+                # the next valid frame — skip only the marker and
+                # rescan them instead of discarding
+                self.bad += 1
+                self._buf = self._buf[1:]
+                continue
+            self._buf = self._buf[_HEADER + n:]
             try:
                 rec = json.loads(raw)
                 if isinstance(rec, dict):
@@ -111,7 +177,7 @@ class FrameDecoder:
                 else:
                     self.bad += 1
             except Exception:
-                self.bad += 1
+                self.bad += 1         # well-framed but unparseable
         return out
 
 
@@ -183,6 +249,18 @@ class TelemetryEmitter:
         except self._full:
             self.dropped += 1
 
+    def inject_garbage(self, data: bytes) -> None:
+        """Chaos-plane hook (tpudist.chaos ``telemetry_garbage``):
+        enqueue raw UNFRAMED bytes that the sender ships verbatim —
+        scripted stream damage the aggregator's FrameDecoder must
+        resynchronise through. Same non-blocking discipline as emit."""
+        if self._stop.is_set():
+            return
+        try:
+            self._q.put_nowait(bytes(data))
+        except self._full:
+            self.dropped += 1
+
     # --------------------------------------------------- sender thread
     def _loop(self) -> None:
         while True:
@@ -194,9 +272,11 @@ class TelemetryEmitter:
                 continue
             self._send(rec)
 
-    def _send(self, rec: Dict[str, Any]) -> None:
+    def _send(self, rec: Any) -> None:
         try:
-            frame = encode_frame(rec)
+            # raw bytes = chaos-injected garbage, shipped unframed
+            frame = (bytes(rec) if isinstance(rec, (bytes, bytearray))
+                     else encode_frame(rec))
             if self.transport == "udp":
                 if self._sock is None:
                     self._sock = socket.socket(socket.AF_INET,

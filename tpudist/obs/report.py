@@ -467,12 +467,11 @@ def collectives_section(doc: Optional[Dict]) -> Optional[Dict[str, Any]]:
 # for each — the Alerts section's cross-check table. The whole point of
 # on-line alerting is that a run which grades fail at exit alerted
 # HOURS earlier; a fail with no matching mid-run alert is a gap in the
-# live engine's coverage and gets flagged as a report warning.
-_EXIT_FAIL_TO_RULE = (
-    ("staging_status", "staging"),
-    ("straggler_status", "straggler"),
-    ("comm_status", "comm"),
-)
+# live engine's coverage and gets flagged as a report warning. The
+# table itself lives in tpudist.rules (shared with the chaos verifier's
+# end-to-end pin of the same invariant) so the two checkers cannot
+# drift.
+_EXIT_FAIL_TO_RULE = rules_lib.STATUS_RULES
 
 
 def alerts_section(metrics: List[Dict[str, Any]],

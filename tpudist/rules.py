@@ -153,6 +153,19 @@ THRESHOLDS: Tuple[Threshold, ...] = (
 ALERT_RULES: Tuple[Threshold, ...] = tuple(
     t for t in THRESHOLDS if t.alert)
 
+# At-exit verdict fields (kind=timing) and the alert rule that grades
+# the same observable — the single source behind BOTH the report CLI's
+# Alerts cross-check and the chaos verifier's end-to-end invariant
+# ("every fail verdict had its matching mid-run alert",
+# tpudist.chaos.verify). A new gate extends THIS table so the two
+# checkers cannot drift; fields whose rule is not alertable
+# (trace_status) deliberately stay off it.
+STATUS_RULES: Tuple[Tuple[str, str], ...] = (
+    ("staging_status", "staging"),
+    ("straggler_status", "straggler"),
+    ("comm_status", "comm"),
+)
+
 _BY_NAME = {t.name: t for t in THRESHOLDS}
 
 

@@ -518,6 +518,35 @@ def check_elastic():
           f"bitwise, policy verdicts held", flush=True)
 
 
+def check_chaos():
+    """The seeded fault matrix end to end (tpudist.chaos): the REAL
+    train CLI is driven in subprocesses on a 4-device CPU mesh under
+    each of the seven fault families — hard kill, watchdog-tripping
+    hang, slow-host straggler, checkpoint-shard corruption, torn
+    manifest, transient filesystem errors, garbage on the live
+    telemetry stream — replaying the launcher's own loop (fault →
+    jax-free policy classification → backoff → ``--resume auto``), and
+    the jax-free invariant checker replays the artifacts: the policy
+    classified every fault correctly, resume came back from the newest
+    COMMITTED step (bitwise vs the unfaulted baseline, by shard-index
+    crc32 — the corrupted-shard family specifically falls back past
+    its crc-rejected manifest), the goodput partition stayed exact
+    with the lost steps counted, and every fail verdict had its
+    matching mid-run alert. Writes into $TPUDIST_CHAOS_DRILL_DIR when
+    set (CI uploads the artifacts), else a temp dir."""
+    from tpudist.chaos import drill as chaos_drill
+    from tpudist.chaos import verify as chaos_verify
+
+    report = chaos_verify.run_and_verify()
+    bad = {name: fam["problems"]
+           for name, fam in report["families"].items() if not fam["ok"]}
+    assert not bad, f"chaos invariants violated: {bad}"
+    assert len(report["families"]) == len(chaos_drill.FAMILIES)
+    print(f"  chaos matrix: {len(report['families'])} fault families "
+          f"green (policy/resume/goodput/alert invariants held; "
+          f"report in {report['run_dir']})", flush=True)
+
+
 def check_flight_recorder():
     """The flight-recorder pipeline end-to-end with a DELIBERATELY
     wedged step: progress beacons flow while steps advance, then the
@@ -672,6 +701,7 @@ def check_moe_smoke():
 
 CHECKS = [
     check_autotune,
+    check_chaos,
     check_devtime,
     check_elastic,
     check_fused_xent,

@@ -281,6 +281,10 @@ def run(cfg: TrainConfig) -> float:
     start_epoch, start_step_in_epoch = 0, 0
     resume_mode = config_lib.resolve_resume(cfg)
     resume_verdict = verdict_lib.UNGATEABLE
+    # populated by a corrupt-checkpoint FALLBACK restore
+    # (elastic.resume: crc-rejected newest manifest, previous committed
+    # step restored instead) — flagged in kind=resume below
+    resume_details: dict = {}
     if resume_mode:
         from tpudist.elastic import resume as elastic_resume
         restored, resume_src, resume_err = None, None, None
@@ -291,7 +295,8 @@ def run(cfg: TrainConfig) -> float:
                     cfg.save_dir, state,
                     run_meta={"seed": cfg.seed,
                               "batch_size": cfg.batch_size,
-                              "model": cfg.model.name})
+                              "model": cfg.model.name},
+                    details=resume_details)
             except Exception as e:
                 if resume_mode != "auto":
                     raise
@@ -323,6 +328,8 @@ def run(cfg: TrainConfig) -> float:
                     resumed_from_step=int(state.step),
                     steps_lost=steps_lost,
                     requeue_attempt=requeue_attempt,
+                    fallback_from=resume_details.get("fallback_from"),
+                    corrupt_shard=resume_details.get("corrupt_shard"),
                     error=repr(resume_err) if resume_err else None)
         if restored is not None:
             log0(f"Resumed at epoch {start_epoch}, step "
@@ -333,6 +340,11 @@ def run(cfg: TrainConfig) -> float:
                     if steps_lost is not None else "")
                  + (f", requeue attempt {requeue_attempt}"
                     if requeue_attempt else ""))
+            if resume_details.get("fallback_from") is not None:
+                log0(f"tpudist: resume fallback: step "
+                     f"{resume_details['fallback_from']} checkpoint is "
+                     f"corrupt ({resume_details.get('corrupt_shard')}); "
+                     f"restored the previous committed step instead")
         elif resume_err is not None:
             log0(f"tpudist: resume {resume_verdict}: restore failed, "
                  f"starting fresh ({resume_err!r})")
@@ -364,6 +376,24 @@ def run(cfg: TrainConfig) -> float:
                              "staging_wait_s": staging.wait_s})
     # the beacon/flight-record correlation keys ride the progress dict
     observer.note_progress(run_id=run_id, requeue_attempt=requeue_attempt)
+
+    # the chaos plane (tpudist.chaos, --chaos/TPUDIST_CHAOS): a seeded,
+    # deterministic fault schedule fired at step boundaries (kill, hang,
+    # slow-host, telemetry garbage) and inside the sharded-checkpoint
+    # write path (shard corruption, torn manifest, transient fs errors
+    # — installed as elastic.ckpt's fault hook BEFORE the checkpointer
+    # opens). Off (the default) constructs nothing and installs no hook.
+    chaos_rt = None
+    chaos_spec = config_lib.resolve_chaos(cfg)
+    if chaos_spec:
+        from tpudist import chaos as chaos_lib
+        chaos_rt = chaos_lib.ChaosRuntime(
+            chaos_lib.ChaosPlan.parse(chaos_spec),
+            process_index=ctx.process_index, observer=observer,
+            emitter=(live.emitter if live is not None else None),
+            metrics=metrics)
+        chaos_rt.install()
+        log0(f"tpudist: chaos on: {chaos_rt.plan.describe()}")
 
     # one manager for the whole run: async saves overlap the next epoch's
     # steps (the old save-per-call shape implied a synchronous drain).
@@ -409,9 +439,12 @@ def run(cfg: TrainConfig) -> float:
                                    superstep=superstep, k=k,
                                    budget_bytes=budget_bytes,
                                    staging=staging, observer=observer,
-                                   profiler_win=win)
+                                   profiler_win=win, chaos=chaos_rt)
         run_ok = True
     finally:
+        if chaos_rt is not None:
+            chaos_rt.uninstall()   # module-level hook must not outlive
+            # the run (in-process harnesses run back to back)
         if win is not None:
             win.close()   # a window wider than the run still stops clean
         observer.note_progress(phase="shutdown")
@@ -419,8 +452,14 @@ def run(cfg: TrainConfig) -> float:
         # the async-checkpoint cost the per-save enqueue_ms cannot see:
         # total time this run spent BLOCKED on serialisation drains
         # (its own kind: every kind=ckpt record stays a per-save record)
+        # — plus the transient-fs-error counters (sharded mode: retries
+        # absorbed, writes abandoned after exhaustion), so a run that
+        # skipped a commit says so in its artifact stream
         metrics.log(kind="ckpt_drain", drain_ms=round(ckpt.drain_ms, 1),
-                    saves=ckpt.saves)
+                    saves=ckpt.saves,
+                    write_errors=getattr(ckpt, "write_errors", 0),
+                    write_retries=getattr(ckpt, "write_retries", 0),
+                    write_skips=getattr(ckpt, "write_skips", 0))
         observer.close()  # stop watchdog/sampler threads, final beacon
         if tracer.enabled and not run_ok:
             # a DYING run exports its local timeline only: the merged
@@ -591,7 +630,8 @@ def run(cfg: TrainConfig) -> float:
 
 def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                      n_steps, epoch, metrics, timer, ckpt, budget_bytes,
-                     staging, observer=None, profiler_win=None):
+                     staging, observer=None, profiler_win=None,
+                     chaos=None):
     """One epoch under superstep dispatch with bounded-memory staging.
 
     ``sharding.plan_slabs`` cuts the epoch into ``(slab_steps, batch,
@@ -702,6 +742,8 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=end)
             _maybe_test_kill(epoch, end, observer)
+            if chaos is not None:
+                chaos.on_step(epoch, end)
             if not dispatched:
                 dispatched = True
                 if timer.warming:
@@ -748,7 +790,8 @@ def _superstep_epoch(cfg, k, mesh, state, superstep, plan, first,
 def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 start_epoch, start_step_in_epoch, metrics, timer, eval_fn,
                 eval_batch, ckpt, superstep=None, k=1, budget_bytes=None,
-                staging=None, observer=None, profiler_win=None):
+                staging=None, observer=None, profiler_win=None,
+                chaos=None):
     last_avg = float("nan")
     staging = StagingStats() if staging is None else staging
     for epoch in range(start_epoch, cfg.epochs):
@@ -783,7 +826,8 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
             state, total, counted, pending = _superstep_epoch(
                 cfg, k, mesh, state, superstep, plan, first, n_steps,
                 epoch, metrics, timer, ckpt, budget_bytes, staging,
-                observer=observer, profiler_win=profiler_win)
+                observer=observer, profiler_win=profiler_win,
+                chaos=chaos)
             last_avg = _epoch_end(cfg, state, total, counted, pending,
                                   n_steps, epoch, metrics, timer, eval_fn,
                                   eval_batch, ckpt, observer=observer)
@@ -805,6 +849,8 @@ def _epoch_loop(cfg, ctx, mesh, state, train_step, epoch_plan,
                 observer.note_progress(phase="train", epoch=epoch,
                                        step=i + 1)
             _maybe_test_kill(epoch, i + 1, observer)
+            if chaos is not None:
+                chaos.on_step(epoch, i + 1)
             if i == first and timer.warming:
                 # fence the first step alone so the timer's warmup absorbs
                 # exactly the trace+compile cost, not a whole fence group —
