@@ -639,6 +639,308 @@ def run_serve_sweep(out_path: str, requests: int = 32,
     return art
 
 
+# ----------------------------------------------------------- overlap sweep
+
+
+def _overlap_capture_exposed(run_once, tag: str) -> tuple:
+    """``(exposed_comm_frac, exposed_comm_s)`` of ONE profiled epoch of
+    ``run_once`` (obs.devtime's interval math over the jax.profiler
+    capture — the SAME analysis the --profile-window train path grades
+    with, so the bench's number and the run report's number are one
+    measurement)."""
+    import shutil
+    import tempfile
+
+    from tpudist.obs import devtime as devtime_lib
+    cap = tempfile.mkdtemp(prefix=f"tpudist_ov_{tag}_")
+    jax.profiler.start_trace(cap)
+    run_once()
+    jax.profiler.stop_trace()
+    pod = devtime_lib.analyze_capture(cap)["pod"]
+    shutil.rmtree(cap, ignore_errors=True)
+    return pod["exposed_comm_frac"] or 0.0, pod["exposed_comm_s"]
+
+
+def run_overlap_sweep(out_path: str, n_steps: int = 16, repeats: int = 2,
+                      k: int = 1, rounds: int = 5) -> dict:
+    """The overlap-plane artifact, BENCH_OVERLAP.json: (a) the DP
+    gradient all-reduce schedule — barrier baseline vs bucketed overlap
+    across bucket sizes, steps/s + devtime-measured exposed-comm
+    fraction + BITWISE loss parity, on the 2-slice scripted DCN mapping
+    (TPUDIST_SLICE_MAP, mesh.axis_fabric labels the data axis "dcn");
+    (b) the pipeline schedule — GPipe vs interleaved-1F1B steps/s at
+    S=4, M=8 with loss parity and the analytic bubble model per row.
+    Headline = bucketed/barrier steps/s at the best bucketed point.
+
+    Measurement honesty, hard-won: (1) both halves warm EVERY cell
+    before timing any and interleave timed rounds across cells —
+    sequential cell timing hands the first (baseline) cell the
+    process's ~30% cold-start cost and manufactures phantom wins; (2)
+    the DP half measures at k=1 (inside a k-step superstep scan the
+    NEXT step's forward overlaps the trailing reduces in EITHER mode —
+    a superstep property, not a schedule property; the superstep x
+    overlap composition is pinned in tests/test_overlap.py); (3) on
+    this CPU backend the two DP schedules then measure within noise —
+    profiling serializes the overlapped concurrency (the capture
+    cannot see what it grades) and the merged per-host track lets
+    replica skew cover either schedule — so the DP rows are recorded
+    diagnostics while the CI-asserted DP evidence is deterministic:
+    bitwise loss parity + the lowered programs' barrier structure
+    (detail.program), the property that stops the collective combiner
+    re-fusing the reduces on the hardware backends where the wall win
+    lives. The pipeline half IS a fair measured win (~1.1x at S=4,
+    M=8)."""
+    import dataclasses
+
+    from tpudist.parallel import build_mesh
+    from tpudist.parallel import mesh as mesh_lib
+    from tpudist.tune import probe
+
+    # the scripted 2-slice DCN stand-in: labeling only, program
+    # unchanged (mesh.slice_assignment). Explicit env wins.
+    os.environ.setdefault("TPUDIST_SLICE_MAP", "2")
+    n_dev = jax.device_count()
+
+    # ---- pipeline half: GPipe vs interleaved at S=4, M=8 ----
+    # (runs FIRST: the DP half's runners hold several hundred MB of
+    # state + staged epochs, and allocator pressure measurably drags
+    # the pipeline cells when they run second)
+    pp_rows = []
+    if n_dev >= 4:
+        # activation-heavy, param-light: the interleaved schedule's win
+        # is the (S-1)(1-1/v) bubble slots of layer compute it removes,
+        # while its cost is per-slot param traffic (chunk select + the
+        # slot scan's carried layer-grad accumulation) — so tokens per
+        # microbatch must dominate param bytes for the bubble cut to
+        # show as wall clock on CPU (on TPU the same ratio comes free:
+        # MXU compute dwarfs HBM param reads at real model sizes)
+        pmodel = ModelConfig(name="transformer", vocab_size=128,
+                             n_layers=8, d_model=128, n_heads=4,
+                             n_kv_heads=4, d_ff=512, max_seq_len=64)
+        S, M = 4, 8
+        pcfg = TrainConfig(batch_size=32, lr=1e-3, seed=0, model=pmodel,
+                           pp_microbatches=M,
+                           data=DataConfig(n_samples=32),
+                           parallel=ParallelConfig(data=1, pipe=S))
+        pmesh = build_mesh(pcfg.parallel, devices=jax.devices()[:S])
+        toks = data.make_synthetic_tokens(pcfg.batch_size,
+                                          pmodel.max_seq_len + 1,
+                                          pmodel.vocab_size, seed=0)
+        from tpudist.parallel import sharding as shd
+        pcells = {}
+        # build + compile + warm BOTH schedules before timing either
+        for v in (1, 2):
+            cfg = dataclasses.replace(pcfg, pipeline_interleave=v)
+            state = engine.init_state(jax.random.PRNGKey(0), cfg, pmesh)
+            step = engine.make_train_step(cfg, pmesh)
+            batch_t = shd.put_batch(pmesh, (toks,))
+            for _ in range(2):
+                state, loss = step(state, batch_t)
+            jax.device_get(loss)
+            # parity pin: one fresh-step loss per schedule
+            fstate = engine.init_state(jax.random.PRNGKey(0), cfg, pmesh)
+            _, floss = step(fstate, batch_t)
+            pcells[v] = [step, state, batch_t, [],
+                         float(jax.device_get(floss))]
+        # timed rounds interleaved across the two schedules
+        for _ in range(max(repeats, 3)):
+            for v, c in pcells.items():
+                t0 = time.perf_counter()
+                for _ in range(4):
+                    c[1], loss = c[0](c[1], c[2])
+                jax.device_get(loss)
+                c[3].append((time.perf_counter() - t0) * 1000 / 4)
+        for v, c in pcells.items():
+            ms = statistics.median(c[3])
+            pp_rows.append({
+                "schedule": "gpipe" if v == 1 else "interleaved",
+                "interleave": v, "stages": S, "microbatches": M,
+                "bubble_model": round((S - 1) / (v * M + S - 1), 4),
+                "step_ms": round(ms, 4),
+                "steps_per_sec": round(1000 / ms, 2),
+                "first_step_loss": c[4]})
+            print(json.dumps(pp_rows[-1]))
+        del pcells
+
+    # ---- DP half: param-heavy little transformer, pure-DP mesh ----
+    # Shape chosen comm-forward (wide layers, short sequences, 1 row
+    # per device): the gradient all-reduce must be a visible fraction
+    # of the device window (~10% exposed at the barrier baseline here)
+    # or the schedule comparison measures profiler noise. ~21 MB of
+    # f32 grads over 8 stacked-layer leaves + embed.
+    model = ModelConfig(name="transformer", vocab_size=256, n_layers=8,
+                        d_model=384, n_heads=4, n_kv_heads=4, d_ff=768,
+                        max_seq_len=16)
+    base = TrainConfig(batch_size=n_dev, lr=1e-3, seed=0,
+                       model=model,
+                       data=DataConfig(n_samples=n_steps * n_dev),
+                       parallel=ParallelConfig(data=-1))
+    mesh = build_mesh(base.parallel)
+    fabric = mesh_lib.data_fabric(mesh)
+    plan = data.plan_epoch(
+        (data.make_synthetic_tokens(base.batch_size * n_steps,
+                                    model.max_seq_len + 1,
+                                    model.vocab_size, base.data.seed),),
+        batch_size=base.batch_size, seed=base.seed, epoch=0)
+
+    cells = [("off", None)] + [("bucketed", mb) for mb in (1.0, 4.0)]
+    runners = {}
+    # phase 1 — build, compile, warm EVERY cell before any timing:
+    # the process's first epochs run cold (allocator growth, code
+    # caches — tune.probe's documented ~30% first-trial bias), and the
+    # baseline cell measuring first would wear all of it
+    for mode, mb in cells:
+        cfg = dataclasses.replace(base, grad_overlap=mode,
+                                  grad_bucket_mb=mb)
+        runner = probe.EpochRunner(cfg, mesh, k, plan, n_steps)
+        state = runner.init_state()
+        state, loss = runner.run_epoch(state)   # trace + compile + warm
+        jax.device_get(loss)
+        # a fresh state for the parity pin: every cell's first-epoch
+        # loss from the identical init must agree BITWISE (the overlap
+        # modes are schedule-only — parallel.overlap)
+        pstate = runner.init_state()
+        pstate, ploss = runner.run_epoch(pstate)
+        # ploss is the last superstep's per-step loss vector (n_steps is
+        # a k-multiple here, so its last entry is a real step's loss)
+        loss_bits = float(jax.device_get(ploss).ravel()[-1])
+        runners[(mode, mb)] = [runner, state, [], loss_bits, []]
+    # phase 2 — timed epochs INTERLEAVED across cells (the staging
+    # sweep's drift-cancelling discipline): each round times every cell
+    # back-to-back so host-load drift hits all modes of a round equally
+    # instead of biasing whichever cell ran later
+    for _ in range(max(repeats, 3)):
+        for key in runners:
+            r = runners[key]
+            t0 = time.perf_counter()
+            s, loss = r[0].run_epoch(r[1])
+            jax.device_get(loss)
+            r[1] = s
+            r[2].append((time.perf_counter() - t0) * 1000 / n_steps)
+    # phase 3 — capture rounds, same interleaving
+    for _ in range(rounds):
+        for key in runners:
+            r = runners[key]
+
+            def once(r=r):
+                s, loss = r[0].run_epoch(r[1])   # donates the state
+                r[1] = s
+                jax.device_get(loss)
+            r[4].append(_overlap_capture_exposed(
+                once, f"{key[0]}_{key[1]}"))
+    rows = []
+    for (mode, mb), (runner, _, times, loss_bits, caps) in \
+            runners.items():
+        ms = statistics.median(times)
+        traces = getattr(runner.dispatch_fn, "traces", None)
+        fracs = [c[0] for c in caps]
+        # captured exposure rides the rows as a labeled DIAGNOSTIC, not
+        # the headline: profiling the CPU thunk runtime serializes the
+        # very concurrency the bucketed schedule buys (measured: the
+        # bucketed cell's captured window runs at the barrier cell's
+        # pace while its un-profiled step time is ~1.3x faster), so
+        # under the profiler the two schedules read alike. The honest
+        # CPU-measurable signal is the un-profiled wall clock below;
+        # per-device TPU tracks don't share the observer effect.
+        per_step_ms = [1e3 * c[1] / n_steps for c in caps]
+        rows.append({"mode": mode, "grad_bucket_mb": mb,
+                     "fabric": fabric,
+                     "step_ms": round(ms, 4),
+                     "steps_per_sec": round(1000 / ms, 1),
+                     "superstep_compiles": (len(traces)
+                                            if traces is not None
+                                            else None),
+                     "first_epoch_loss": loss_bits,
+                     "exposed_comm_frac": round(
+                         statistics.median(fracs), 5),
+                     "exposed_comm_frac_reps": [round(f, 5)
+                                                for f in fracs],
+                     "exposed_comm_ms_per_step": round(
+                         statistics.median(per_step_ms), 4),
+                     "exposed_comm_ms_per_step_reps": [
+                         round(x, 4) for x in per_step_ms]})
+        print(json.dumps(rows[-1]))
+    off_row = rows[0]
+    best = max(rows[1:], key=lambda r: r["steps_per_sec"])
+    reduction = round(best["steps_per_sec"] / off_row["steps_per_sec"],
+                      4)
+
+    # the DETERMINISTIC schedule evidence (what CPU wall clock cannot
+    # adjudicate — fair interleaved timing measures the two schedules
+    # within ±3% here, sign unstable): the lowered programs must carry
+    # the structure the modes promise — off barriers every grad leaf
+    # once; bucketed threads one barrier per chain link, which is what
+    # stops the collective combiner re-fusing the reduces into the
+    # trailing all-reduce on hardware backends
+    def _barrier_count(mode, mb):
+        from jax.sharding import PartitionSpec as P
+
+        from tpudist.parallel import sharding as shd
+        from tpudist.utils import compat
+        cfg = dataclasses.replace(base, grad_overlap=mode,
+                                  grad_bucket_mb=mb)
+        state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
+        body, _, _ = engine._build_step_body(cfg, mesh)
+
+        def jitted(st, batch):
+            bspecs = jax.tree.map(
+                lambda x: shd.batch_spec(x.ndim), batch)
+            return compat.shard_map(body, mesh=mesh,
+                                    in_specs=(P(), bspecs),
+                                    out_specs=(P(), P()),
+                                    check_vma=False)(st, batch)
+        batch = jax.tree.map(lambda a: a[0], plan.slab(0, 1))
+        staged = shd.put_batch(mesh, batch)
+        txt = jax.jit(jitted).lower(state, staged).as_text()
+        return txt.count("optimization_barrier")
+    program = {
+        "off_barriers": _barrier_count("off", None),
+        "bucketed_barrier_chain": _barrier_count(
+            "bucketed", best["grad_bucket_mb"]),
+    }
+
+    art = {
+        "metric": "grad_overlap_steps_ratio",
+        "value": reduction,
+        "unit": "bucketed steps/s / barrier-baseline steps/s at "
+                "bitwise-identical loss (4-dev CPU mesh, scripted "
+                "2-slice DCN map; captured exposure rides the rows)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": n_dev,
+            "model": "transformer", "global_batch": base.batch_size,
+            "k": k, "n_steps": n_steps,
+            "slice_map": os.environ.get("TPUDIST_SLICE_MAP"),
+            "data_axis_fabric": fabric,
+            "rows": rows,
+            "best_bucket_mb": best["grad_bucket_mb"],
+            "program": program,
+            "exposed_comm_frac_drop": round(
+                off_row["exposed_comm_frac"]
+                - best["exposed_comm_frac"], 5),
+            "loss_bitwise_identical": all(
+                r["first_epoch_loss"] == off_row["first_epoch_loss"]
+                for r in rows),
+            "one_compile_per_cell": all(
+                r["superstep_compiles"] in (None, 1) for r in rows),
+            "steps_ratio_best_vs_off": round(
+                best["steps_per_sec"] / off_row["steps_per_sec"], 4),
+            "pipeline_rows": pp_rows,
+            **({"pipeline_interleaved_vs_gpipe_steps_ratio": round(
+                    pp_rows[1]["steps_per_sec"]
+                    / pp_rows[0]["steps_per_sec"], 4),
+                "pipeline_loss_bitwise_identical": (
+                    pp_rows[0]["first_step_loss"]
+                    == pp_rows[1]["first_step_loss"])}
+               if len(pp_rows) == 2 else {}),
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({k_: art[k_] for k_ in ("metric", "value", "unit")}))
+    return art
+
+
 # --------------------------------------------------------- collective sweep
 
 
@@ -862,6 +1164,15 @@ def main() -> None:
                         "BENCH_SERVE.json")
     p.add_argument("--serve-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_SERVE.json"))
+    p.add_argument("--overlap-sweep", action="store_true",
+                   help="bench the overlap plane: DP gradient "
+                        "all-reduce barrier-vs-bucketed (steps/s + "
+                        "devtime exposed-comm frac across bucket "
+                        "sizes, bitwise loss parity, scripted 2-slice "
+                        "DCN labels) and GPipe-vs-interleaved pipeline "
+                        "steps/s at S=4, M=8; write BENCH_OVERLAP.json")
+    p.add_argument("--overlap-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_OVERLAP.json"))
     p.add_argument("--collective-sweep", action="store_true",
                    help="sweep the collectives over the mesh's data "
                         "axis (ICI/DCN-labeled) and write "
@@ -912,6 +1223,9 @@ def main() -> None:
         return
     if args.serve_sweep:
         run_serve_sweep(args.serve_out)
+        return
+    if args.overlap_sweep:
+        run_overlap_sweep(args.overlap_out)
         return
     if args.collective_sweep:
         run_collective_sweep(args.collective_out, args.collective_kinds,

@@ -56,12 +56,57 @@ def make_agg(tmp_path, **kw):
 
 def test_rules_table_names_and_alert_subset():
     names = {t.name for t in rules_lib.THRESHOLDS}
-    assert names == {"straggler", "staging", "comm", "regress", "stall",
-                     "trace_drop", "ttft", "itl", "tokens_per_chip",
-                     "goodput"}
-    # every rule but the artifact-quality one is a live alert
+    assert names == {"straggler", "staging", "comm", "comm_dcn",
+                     "regress", "stall", "trace_drop", "ttft", "itl",
+                     "tokens_per_chip", "goodput"}
+    # every rule but the artifact-quality one and the DCN threshold row
+    # is a live alert (comm_dcn is a per-fabric CEILING the comm alert
+    # substitutes via resolve_comm, not its own (rule, host) key — the
+    # at-exit comm_status cross-check must find ONE matching alert)
     assert {t.name for t in rules_lib.ALERT_RULES} == names - {
-        "trace_drop"}
+        "trace_drop", "comm_dcn"}
+
+
+def test_rules_resolve_comm_fabric_dispatch(monkeypatch):
+    assert rules_lib.resolve_comm(None) == rules_lib.COMM_EXPOSED_MAX
+    assert rules_lib.resolve_comm("ici") == rules_lib.COMM_EXPOSED_MAX
+    assert rules_lib.resolve_comm("dcn") == rules_lib.COMM_EXPOSED_MAX_DCN
+    # each fabric's ceiling has its OWN env override
+    monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX_DCN", "0.6")
+    assert rules_lib.resolve_comm("dcn") == 0.6
+    assert rules_lib.resolve_comm("ici") == rules_lib.COMM_EXPOSED_MAX
+    monkeypatch.setenv("TPUDIST_COMM_EXPOSED_MAX", "0.1")
+    assert rules_lib.resolve_comm("ici") == 0.1
+    assert rules_lib.resolve_comm("dcn") == 0.6
+
+
+def test_devtime_record_fabric_grades_live_comm_alert(tmp_path):
+    """Consumer parity, per fabric: a DCN-labeled devtime record whose
+    exposed frac sits BETWEEN the ICI and DCN ceilings must not alert
+    (and comm_status agrees); past the DCN ceiling both graders flag —
+    under the one 'comm' alert key the report cross-check looks up."""
+    frac_mid = (rules_lib.COMM_EXPOSED_MAX
+                + rules_lib.COMM_EXPOSED_MAX_DCN) / 2
+    agg, clk = make_agg(tmp_path)
+    agg.ingest({"kind": "devtime", "exposed_comm_frac": frac_mid,
+                "fabric": "dcn"}, now=clk.t)
+    assert not agg.engine.firing()
+    assert devtime_lib.comm_status(frac_mid,
+                                   fabric="dcn") == verdict_lib.SUCCESS
+    # the same number on an ICI row flags in both graders
+    agg2, clk2 = make_agg(tmp_path / "ici")
+    agg2.ingest({"kind": "devtime", "exposed_comm_frac": frac_mid,
+                 "fabric": "ici"}, now=clk2.t)
+    assert {a["alert"] for a in agg2.engine.firing()} == {"comm"}
+    assert devtime_lib.comm_status(frac_mid,
+                                   fabric="ici") == verdict_lib.FAIL
+    # past the DCN ceiling the dcn row flags too, still as "comm"
+    bad = rules_lib.COMM_EXPOSED_MAX_DCN + 0.1
+    agg.ingest({"kind": "devtime", "exposed_comm_frac": bad,
+                "fabric": "dcn"}, now=clk.t)
+    assert {a["alert"] for a in agg.engine.firing()} == {"comm"}
+    assert devtime_lib.comm_status(bad,
+                                   fabric="dcn") == verdict_lib.FAIL
 
 
 def test_rules_resolve_env_override(monkeypatch):

@@ -65,25 +65,14 @@ def sweep_sizes(min_mb: float = 1, max_mb: float = 1024) -> List[int]:
 
 
 def axis_fabric(mesh, axis: str) -> str:
-    """Label a mesh axis ``ici`` or ``dcn`` from the devices it spans.
-
-    An axis whose neighbouring devices sit on different SLICES crosses
-    the data-center network; within one slice it rides the ICI torus.
-    The probe walks the mesh's device array: fix every other axis and
-    look at the set of ``slice_index`` values along this one — more
-    than one distinct slice anywhere ⇒ DCN. Devices without a
-    ``slice_index`` attribute (CPU, single-slice TPU runtimes) read as
-    one slice, i.e. ICI — exactly the bandwidth class their collective
-    actually gets."""
-    import numpy as np
-    devs = mesh.devices
-    idx = list(mesh.axis_names).index(axis)
-    cols = np.moveaxis(devs, idx, 0).reshape(devs.shape[idx], -1)
-    for j in range(cols.shape[1]):
-        slices = {getattr(d, "slice_index", 0) or 0 for d in cols[:, j]}
-        if len(slices) > 1:
-            return "dcn"
-    return "ici"
+    """Label a mesh axis ``ici`` or ``dcn``. The implementation moved to
+    :func:`tpudist.parallel.mesh.axis_fabric` (an axis's fabric is a
+    mesh property, now also consumed by the devtime per-fabric comm
+    grading and the overlap bench — and it honors the scripted
+    ``TPUDIST_SLICE_MAP`` 2-slice DCN stand-in); this alias keeps the
+    sweep's documented surface."""
+    from tpudist.parallel import mesh as mesh_lib
+    return mesh_lib.axis_fabric(mesh, axis)
 
 
 def collectives_artifact(records: List[dict]) -> dict:

@@ -110,7 +110,22 @@ class TrainConfig:
     # defers to fused_xent/xent_chunks when set, else picks by the memory
     # policy (models.transformer.pick_lm_head)
     pp_microbatches: int = 0      # pipeline microbatches (0 = pipe size)
+    pipeline_interleave: int = 0  # virtual stages per pipeline device
+    # (parallel.pipeline interleaved schedule): v>1 cuts the bubble from
+    # (S-1)/(M+S-1) to (S-1)/(v*M+S-1) by giving each device v
+    # round-robin layer chunks. 0 = $TPUDIST_PIPELINE_INTERLEAVE, else 1
+    # (the GPipe parity oracle)
     cp_impl: str = "ring"         # context parallelism: ring | ulysses
+    grad_overlap: Optional[str] = None  # off | bucketed — DP gradient
+    # all-reduce schedule (parallel.overlap): off pins the trailing-
+    # barrier baseline (reduce after the whole backward), bucketed
+    # splits the reduce into size-bounded buckets dispatched as the
+    # backward produces each bucket's grads, hidden behind the
+    # remaining backward compute (the multi-slice DCN recipe). None =
+    # $TPUDIST_GRAD_OVERLAP, else off. Bitwise-identical loss either
+    # way; only the schedule (and the exposed-comm fraction) moves
+    grad_bucket_mb: Optional[float] = None  # bucket size bound in MB for
+    # --grad-overlap bucketed. None = $TPUDIST_GRAD_BUCKET_MB, else 4
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     chaos: Optional[str] = None   # scripted fault-injection plan
     # (tpudist.chaos): ";"-separated <fault>@<epoch>:<step>[:<rank>]
@@ -353,6 +368,56 @@ def resolve_autotune_trials(cfg: TrainConfig) -> int:
         return cfg.autotune_trials
     env = _env_float("TPUDIST_AUTOTUNE_TRIALS")
     return int(env) if env and env > 0 else AUTOTUNE_DEFAULT_TRIALS
+
+
+# Gradient-overlap plane (tpudist.parallel.overlap): the DP all-reduce
+# schedule knob and its bucket bound. The default bucket mirrors
+# overlap.DEFAULT_BUCKET_MB (kept as a literal here so config stays
+# importable before jax — the two are pinned equal in tests).
+GRAD_OVERLAP_MODES = ("off", "bucketed")
+GRAD_BUCKET_MB_DEFAULT = 4.0
+
+
+def resolve_grad_overlap(cfg: TrainConfig) -> tuple[str, int]:
+    """Resolve ``--grad-overlap`` / ``--grad-bucket-mb`` to the concrete
+    ``(mode, bucket_bytes)`` pair the engine's DP path dispatches on.
+
+    Precedence per knob: explicit flag > env (``TPUDIST_GRAD_OVERLAP``,
+    ``TPUDIST_GRAD_BUCKET_MB``) > default (off, 4 MB). The mode applies
+    to the explicit-collective DP shard_map path only — the engine
+    raises on meshes that route gradients through the jit+shardings
+    partitioner (there is no program-level reduce there to schedule)."""
+    mode = cfg.grad_overlap
+    if mode is None:
+        mode = os.environ.get("TPUDIST_GRAD_OVERLAP") or "off"
+    if mode not in GRAD_OVERLAP_MODES:
+        raise ValueError(
+            f"--grad-overlap must be one of {GRAD_OVERLAP_MODES}, "
+            f"got {mode!r}")
+    mb = cfg.grad_bucket_mb
+    if mb is None:
+        mb = _env_float("TPUDIST_GRAD_BUCKET_MB")
+    if mb is None:
+        mb = GRAD_BUCKET_MB_DEFAULT
+    if mb <= 0:
+        raise ValueError(f"--grad-bucket-mb must be > 0, got {mb}")
+    return mode, int(mb * 2**20)
+
+
+def resolve_pipeline_interleave(cfg: TrainConfig) -> int:
+    """Resolve ``--pipeline-interleave`` to the virtual-stage count v
+    (1 = GPipe). Precedence: explicit flag > env > 1. Divisibility
+    against the layer/stage/microbatch shape is validated where those
+    are known (parallel.pipeline.make_pp_loss_fn)."""
+    v = cfg.pipeline_interleave
+    if v < 0:
+        raise ValueError(
+            f"--pipeline-interleave must be >= 1 (or 0 = default), "
+            f"got {v}")
+    if v == 0:
+        env = _env_float("TPUDIST_PIPELINE_INTERLEAVE")
+        v = int(env) if env and env > 0 else 1
+    return v
 
 
 # Elastic checkpoint/resume (tpudist.elastic): the checkpoint layout and
@@ -630,6 +695,27 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
                    help="expert mesh axis size (MoE expert parallelism)")
     p.add_argument("--pp-microbatches", type=int, default=0,
                    help="pipeline microbatches per step (0 = pipe size)")
+    p.add_argument("--pipeline-interleave", type=int, default=0,
+                   help="virtual pipeline stages per device: v>1 runs "
+                        "the interleaved schedule (each device holds v "
+                        "round-robin layer chunks), cutting the bubble "
+                        "from (S-1)/(M+S-1) to (S-1)/(v*M+S-1); "
+                        "requires n-layers divisible by pipe*v and "
+                        "microbatches divisible by pipe (default: "
+                        "$TPUDIST_PIPELINE_INTERLEAVE, else 1 = GPipe)")
+    p.add_argument("--grad-overlap", type=str, default=None,
+                   choices=list(GRAD_OVERLAP_MODES),
+                   help="DP gradient all-reduce schedule "
+                        "(tpudist.parallel.overlap): off = trailing-"
+                        "barrier baseline (reduce after the whole "
+                        "backward), bucketed = size-bounded buckets "
+                        "dispatched as backward produces them, hidden "
+                        "behind the remaining backward compute; "
+                        "bitwise-identical loss either way (default: "
+                        "$TPUDIST_GRAD_OVERLAP, else off)")
+    p.add_argument("--grad-bucket-mb", type=float, default=None,
+                   help="bucket size bound for --grad-overlap bucketed "
+                        "(default: $TPUDIST_GRAD_BUCKET_MB, else 4)")
     p.add_argument("--cp-impl", type=str, default="ring",
                    choices=list(CP_IMPLS),
                    help="context-parallel attention: kv ring rotation "
@@ -772,7 +858,10 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         fused_xent=args.fused_xent,
         lm_head=args.lm_head,
         pp_microbatches=args.pp_microbatches,
+        pipeline_interleave=args.pipeline_interleave,
         cp_impl=args.cp_impl,
+        grad_overlap=args.grad_overlap,
+        grad_bucket_mb=args.grad_bucket_mb,
         fail_at=args.fail_at,
         chaos=args.chaos,
         log_every=args.log_every,

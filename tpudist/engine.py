@@ -285,12 +285,15 @@ def make_loss_fn(cfg: TrainConfig, mesh: Mesh | None = None, *,
             raise ValueError(
                 "pipe and context parallelism both manualize their own "
                 "mesh axis in a shard_map and do not compose; pick one")
+        from tpudist.config import resolve_pipeline_interleave
         from tpudist.parallel.pipeline import make_pp_loss_fn
         pp_loss = make_pp_loss_fn(cfg.model, mesh,
                                   n_microbatches=cfg.pp_microbatches,
                                   dtype=dt, remat=cfg.remat,
                                   xent_chunks=xent_chunks,
-                                  fused_xent=fused_xent)
+                                  fused_xent=fused_xent,
+                                  interleave=resolve_pipeline_interleave(
+                                      cfg))
 
         def loss(params, batch):
             tokens = batch[0] if isinstance(batch, tuple) else batch
@@ -425,15 +428,29 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
     annotations and ``st_sh`` holds the TrainState's NamedShardings.
     """
     tx = make_optimizer(cfg)
-    axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    pure_dp = all(axis_sizes.get(a, 1) == 1
-                  for a in ("pipe", "fsdp", "expert", "tensor", "context"))
-    dp = pure_dp and axis_sizes["data"] > 1
+    dp = shd.pure_dp(mesh)
     # the logits constraint belongs to the jit+shardings path only — inside
     # the shard_map DP body every mesh axis is manual and a NamedSharding
     # constraint is rejected at trace time
     loss_fn = make_loss_fn(cfg, mesh, constrain_logits=not dp)
     st_sh = None if dp else state_shardings(cfg, mesh)
+    from tpudist.config import resolve_grad_overlap
+    overlap_mode, bucket_bytes = resolve_grad_overlap(cfg)
+    if overlap_mode != "off" and not dp:
+        if any(int(s) > 1 for s in mesh.devices.shape):
+            # the bucketed schedule rewrites the PROGRAM's explicit
+            # psums; on jit+shardings meshes the gradient reduction is
+            # inserted by the partitioner and there is nothing
+            # program-level to re-schedule — a silently-inert flag
+            # would fake the acceptance signal, so refuse loudly
+            raise ValueError(
+                f"--grad-overlap {overlap_mode} requires the explicit-"
+                f"collective pure-DP mesh (only the 'data' axis > 1); "
+                f"this mesh routes gradients through the jit+shardings "
+                f"partitioner")
+        # a single-device mesh has no all-reduce at all: the flag is
+        # inert (a laptop dry-run of a pod launch script must not crash)
+        overlap_mode = "off"
 
     def sgd_update(state: TrainState, loss, grads):
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -442,13 +459,21 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
                           opt_state=new_opt), loss
 
     if dp:
+        from tpudist.parallel import overlap as overlap_lib
+
         def body(state: TrainState, batch):
             loss, grads = _microbatch(loss_fn, state.params, batch,
                                       cfg.grad_accum_steps)
             # THE collective under test: gradient all-reduce over ICI/DCN
             # (reference equivalent: NCCL all-reduce inside
-            # model_engine.backward(), train.py:113).
-            grads = lax.pmean(grads, "data")
+            # model_engine.backward(), train.py:113). The schedule is a
+            # program property (parallel.overlap): "off" pins the
+            # trailing-barrier baseline, "bucketed" chains size-bounded
+            # per-bucket reduces behind the backward — bitwise-identical
+            # math either way, only the exposed-comm fraction moves.
+            grads = overlap_lib.grad_mean(grads, "data",
+                                          mode=overlap_mode,
+                                          bucket_bytes=bucket_bytes)
             loss = lax.pmean(loss, "data")
             return sgd_update(state, loss, grads)
     else:

@@ -181,7 +181,9 @@ def device_op_tracks(doc: Dict[str, Any]
     (``/device:TPU:0``) whose "XLA Ops" thread carries the op events —
     each such pid is one track. The CPU backend has no device
     processes; its op events land on the PJRT client's pool threads
-    (``tf_XLATfrtCpuClient/*``) inside the ``/host:CPU`` process, so
+    (``tf_XLATfrtCpuClient/*`` — and, under the thunk runtime newer
+    jaxlibs use, the Eigen compute pool ``tf_XLAEigen/*``, where the
+    HLO op events actually live) inside the ``/host:CPU`` process, so
     all of them fold into ONE synthetic track per host process (the
     virtual devices share the pool — per-device attribution is a
     hardware concept; the CPU track exists so the plumbing is testable
@@ -222,7 +224,7 @@ def device_op_tracks(doc: Dict[str, Any]
             track = device_pids[pid]
         else:
             tn = thread_names.get((pid, tid), "")
-            if not tn.startswith("tf_XLATfrtCpuClient"):
+            if not tn.startswith(("tf_XLATfrtCpuClient", "tf_XLAEigen")):
                 continue
             if classify(name) is None:
                 continue
@@ -341,18 +343,26 @@ def analyze_capture(capture_dir: str) -> Dict[str, Any]:
 # paying for its fabric in steps/s. Advisory, like the staging and
 # straggler gates; env override TPUDIST_COMM_EXPOSED_MAX (call time).
 # The threshold itself lives in tpudist.rules, shared with the live
-# alert engine so mid-run and at-exit grading cannot drift.
+# alert engine so mid-run and at-exit grading cannot drift. DCN-labeled
+# rows (a data axis crossing slices) grade against their own ceiling.
 COMM_EXPOSED_MAX = rules_lib.COMM_EXPOSED_MAX
+COMM_EXPOSED_MAX_DCN = rules_lib.COMM_EXPOSED_MAX_DCN
 
 
 def comm_status(exposed_frac: Optional[float],
-                max_frac: Optional[float] = None) -> str:
+                max_frac: Optional[float] = None,
+                fabric: Optional[str] = None) -> str:
     """Three-valued exposed-communication verdict: UNGATEABLE when no
     device window was measured (capture off or empty), else
     SUCCESS/FAIL by whether the exposed-comm fraction of the device
-    window stays under the threshold."""
+    window stays under the threshold. ``fabric`` selects the per-fabric
+    default (``tpudist.rules.resolve_comm``): a data axis crossing
+    slices grades against the DCN ceiling
+    (``TPUDIST_COMM_EXPOSED_MAX_DCN``) — a slower fabric honestly costs
+    more exposure before the run is flagged — while ICI rows keep
+    ``TPUDIST_COMM_EXPOSED_MAX``. An explicit ``max_frac`` wins."""
     if max_frac is None:
-        max_frac = rules_lib.resolve("comm")
+        max_frac = rules_lib.resolve_comm(fabric)
     if exposed_frac is None:
         return UNGATEABLE
     return SUCCESS if exposed_frac <= max_frac else FAIL

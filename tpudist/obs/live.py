@@ -489,7 +489,14 @@ class LiveAggregator:
         elif kind == "devtime":
             frac = rec.get("exposed_comm_frac")
             self._pod["exposed_comm_frac"] = frac
+            fabric = rec.get("fabric")
+            if fabric is not None:
+                self._pod["comm_fabric"] = fabric
+            # fabric-graded: a DCN-labeled record substitutes the DCN
+            # ceiling but keeps the ONE "comm" rule key, so the at-exit
+            # comm_status cross-check still finds its matching alert
             self.engine.observe("comm", frac,
+                                threshold=rules_lib.resolve_comm(fabric),
                                 step=self._pod.get("step"))
         elif kind == "ckpt":
             self._pod["ckpt_saves"] += 1

@@ -395,13 +395,19 @@ def devtime_section(events, metrics, baseline: Optional[Dict]
             if rec.get(k) is not None:
                 pod[k] = rec[k]
 
-    status = devtime_mod.comm_status(pod["exposed_comm_frac"])
+    # fabric-graded at fold time: the record's axis_fabric label picks
+    # the ICI or DCN ceiling (tpudist.rules.resolve_comm) — same
+    # dispatch the live alert engine applied mid-run
+    fabric = (recs[-1].get("fabric") if recs else None)
+    status = devtime_mod.comm_status(pod["exposed_comm_frac"],
+                                     fabric=fabric)
     base_frac = _find_exposed_frac(baseline) if baseline else None
     delta = (round(pod["exposed_comm_frac"] - base_frac, 6)
              if (pod["exposed_comm_frac"] is not None
                  and base_frac is not None) else None)
     return {
         "comm_status": status,
+        "fabric": fabric,
         "devices": devices,
         "pod": pod,
         "exposed_by_phase": exposed_by_phase,
@@ -918,7 +924,10 @@ def to_markdown(report: Dict[str, Any]) -> str:
         pod = dt["pod"]
         lines += ["## Device time (compute vs exposed communication)",
                   "",
-                  f"**comm_status: {dt['comm_status']}** — exposed "
+                  f"**comm_status: {dt['comm_status']}**"
+                  + (f" ({dt['fabric']}-graded)"
+                     if dt.get("fabric") else "")
+                  + f" — exposed "
                   f"comm {pod['exposed_comm_s']:.3f}s summed over "
                   f"{pod['devices']} device track(s), "
                   f"{100 * (pod['exposed_comm_frac'] or 0):.1f}% of "

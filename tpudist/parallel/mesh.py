@@ -17,8 +17,9 @@ weight gathers; pipe (latency-tolerant point-to-point activations) and data
 
 from __future__ import annotations
 
+import os
 from dataclasses import dataclass
-from typing import Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 from jax.sharding import Mesh
@@ -79,3 +80,112 @@ def build_mesh(cfg: Optional[ParallelConfig] = None,
         return compat.make_mesh(sizes, AXIS_NAMES, axis_types=auto)
     import numpy as np
     return Mesh(np.asarray(devices).reshape(sizes), AXIS_NAMES)
+
+
+# ------------------------------------------------- slice / fabric layout
+#
+# Multi-slice awareness: a TPU pod of several slices exposes
+# ``device.slice_index``; collectives whose mesh axis crosses slices
+# ride DCN, everything else the ICI torus. CPU test meshes have no
+# slices, so ``TPUDIST_SLICE_MAP`` scripts one — either an integer N
+# ("split the devices into N equal contiguous slices by device id", the
+# 2-slice DCN stand-in the overlap acceptance lane uses) or an explicit
+# comma list of per-device slice indices. The scripted map changes only
+# LABELING (axis_fabric -> "dcn", the comm_dcn grading threshold), never
+# the compiled program: CPU collectives cannot be made to traverse a
+# real DCN, but the attribution/grading plumbing is identical either
+# way, which is exactly what makes it CI-testable.
+
+
+def resolve_slice_map(n_devices: int) -> Optional[List[int]]:
+    """``TPUDIST_SLICE_MAP`` -> per-device slice index for a full
+    world of ids ``0..n_devices-1``, or None when unset. A thin list
+    view over :func:`slice_assignment` — ONE parser of the env var —
+    kept because "the whole world as a list" is the natural shape for
+    tests and tooling. Malformed values raise: a scripted topology is
+    an explicit test/bench request, not an advisory knob."""
+    assigned = slice_assignment(range(n_devices))
+    if assigned is None:
+        return None
+    return [assigned[i] for i in range(n_devices)]
+
+
+def slice_assignment(devices) -> Optional[Dict[int, int]]:
+    """The scripted slice of each of THESE devices (``{device_id:
+    slice}``), or None when ``TPUDIST_SLICE_MAP`` is unset. The integer
+    form splits the given devices' sorted ids into N contiguous runs —
+    well-defined on a submesh (a 2-device test mesh of an 8-device
+    world splits ITS devices) — while the explicit list form is global
+    by device id and must cover every id present."""
+    raw = os.environ.get("TPUDIST_SLICE_MAP")
+    if not raw:
+        return None
+    vals = [int(p) for p in raw.split(",") if p.strip()]
+    ids = sorted(int(getattr(d, "id", i))
+                 for i, d in enumerate(devices))
+    if len(vals) == 1:
+        n_slices = vals[0]
+        if n_slices < 1 or len(ids) % n_slices:
+            raise ValueError(
+                f"TPUDIST_SLICE_MAP={raw!r}: {len(ids)} devices not "
+                f"divisible into {n_slices} equal slices")
+        per = len(ids) // n_slices
+        return {d: i // per for i, d in enumerate(ids)}
+    for d in ids:
+        if d < 0 or d >= len(vals):
+            raise ValueError(
+                f"TPUDIST_SLICE_MAP={raw!r}: {len(vals)} entries do "
+                f"not cover device id {d}")
+    return {d: vals[d] for d in ids}
+
+
+def device_slice_index(device,
+                       scripted: Optional[Dict[int, int]] = None) -> int:
+    """One device's slice: the scripted map (by device id) wins, else
+    the runtime's ``slice_index`` attribute, else 0 (single slice)."""
+    if scripted is not None:
+        did = int(getattr(device, "id", 0))
+        if did in scripted:
+            return scripted[did]
+    return int(getattr(device, "slice_index", 0) or 0)
+
+
+def axis_fabric(mesh: Mesh, axis: str) -> str:
+    """Label a mesh axis ``ici`` or ``dcn`` from the devices it spans.
+
+    An axis whose neighbouring devices sit on different SLICES crosses
+    the data-center network; within one slice it rides the ICI torus.
+    The probe walks the mesh's device array: fix every other axis and
+    look at the set of slice indices along this one — more than one
+    distinct slice anywhere ⇒ DCN. Devices without a slice (CPU without
+    a scripted ``TPUDIST_SLICE_MAP``, single-slice TPU runtimes) read
+    as one slice, i.e. ICI — exactly the bandwidth class their
+    collective actually gets. (Moved here from tpudist.bench.sweep: the
+    fabric of an axis is a MESH property, consumed by the sweep's
+    artifact rows, the devtime comm grading, and the overlap bench.)"""
+    import numpy as np
+    devs = mesh.devices
+    scripted = slice_assignment(devs.ravel())
+    idx = list(mesh.axis_names).index(axis)
+    cols = np.moveaxis(devs, idx, 0).reshape(devs.shape[idx], -1)
+    for j in range(cols.shape[1]):
+        slices = {device_slice_index(d, scripted) for d in cols[:, j]}
+        if len(slices) > 1:
+            return "dcn"
+    return "ici"
+
+
+def mesh_fabrics(mesh: Mesh) -> Dict[str, str]:
+    """Every size->1 axis's fabric label — the ``axis_fabric`` map the
+    devtime record and the run report carry (axes of size 1 have no
+    collective to label)."""
+    return {axis: axis_fabric(mesh, axis)
+            for axis in mesh.axis_names if mesh.shape[axis] > 1}
+
+
+def data_fabric(mesh: Mesh) -> str:
+    """The DP gradient all-reduce's fabric: the ``data`` axis label
+    when that axis is real, else ICI (no cross-device reduce at all)."""
+    if mesh.shape.get("data", 1) > 1:
+        return axis_fabric(mesh, "data")
+    return "ici"

@@ -1,0 +1,169 @@
+"""Gradient all-reduce overlap: bucketed reduces hidden behind backward.
+
+The DP engine path's ``lax.pmean(grads, "data")`` leaves the schedule of
+the gradient all-reduce entirely to XLA — and on TPU the collective
+combiner + latency-hiding scheduler turn the per-leaf psums into ONE
+fused all-reduce sitting after the last backward op: the whole reduction
+is exposed at the step tail, which on a multi-slice DCN data axis is
+exactly where the fabric bill lands (the pjit/TPUv4 multi-slice recipe
+in PAPERS.md overlaps the DCN gradient reduction behind the backward
+pass for this reason). This module makes the overlap a PROGRAM property
+instead of a scheduler accident:
+
+  * :func:`plan_buckets` — reverse-topological bucketing of the grad
+    pytree: leaves are walked in REVERSE flatten order (the params'
+    tree order tracks forward use, so its reverse approximates the
+    order the backward pass produces grads — last layer first) and
+    greedily packed into size-bounded buckets (``bucket_bytes``).
+  * :func:`bucketed_mean` — one ``lax.pmean`` per bucket, issued in the
+    order backward produces them, each bucket CHAINED to the previous
+    reduce through ``lax.optimization_barrier``. The chain is the whole
+    trick: without a data dependency between them XLA's collective
+    combiner is free to merge every pmean back into the single trailing
+    all-reduce the knob exists to break up, and the scheduler is free
+    to sink them past the backward. With it, bucket ``i``'s reduce must
+    issue before bucket ``i+1``'s — while the backward compute of the
+    EARLIER layers (which feeds later buckets and depends on no reduce)
+    runs concurrently, hiding the reduce latency.
+  * :func:`barrier_mean` — the ``--grad-overlap off`` baseline with the
+    trailing-barrier semantics PINNED: every grad leaf passes one
+    ``optimization_barrier`` together, so no reduce can issue before
+    the whole backward has finished. This is the program the fused
+    single all-reduce lowers to on TPU anyway; pinning it makes "off"
+    mean the same thing on every backend (the CPU thunk runtime never
+    runs the combiner, so a naked per-leaf pmean there is already
+    accidentally overlapped — a baseline that moves under the
+    measurement is no baseline).
+
+Every mode is arithmetic-identical: a barrier is the identity and the
+per-leaf pmean math is unchanged, so losses are BITWISE equal across
+``off``/``bucketed`` (pinned in tests/test_overlap.py, the way PR 1/2
+pinned superstep parity). Only the schedule — and therefore the
+exposed-communication fraction obs.devtime measures — differs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence
+
+import jax
+from jax import lax
+
+# --grad-overlap vocabulary (config.resolve_grad_overlap validates)
+GRAD_OVERLAP_MODES = ("off", "bucketed")
+
+# Default bucket bound: big enough that a bucket's DCN all-reduce
+# amortises its latency, small enough that the first reduce issues
+# early in the backward. The autotuner (tune.search) owns finding the
+# real optimum per workload; this is only the knob's resting value.
+DEFAULT_BUCKET_MB = 4.0
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """Reverse-topological bucketing of a grad pytree's leaves.
+
+    ``buckets`` holds flatten-order leaf indices, grouped; bucket 0 is
+    the FIRST to reduce (the leaves backward finishes first). Pure
+    shape metadata — hashable inputs in, static python out — so the
+    plan is computed at trace time from the traced grads' avals and
+    never costs a device byte.
+    """
+
+    buckets: tuple          # tuple[tuple[int, ...], ...]
+    leaf_bytes: tuple       # per-leaf nbytes, flatten order
+    bucket_bytes: int
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.buckets)
+
+    @property
+    def total_bytes(self) -> int:
+        return sum(self.leaf_bytes)
+
+
+def leaf_nbytes(leaf: Any) -> int:
+    """Byte size of an array-like from shape/dtype alone (works on
+    tracers and ShapeDtypeStructs — no ``.nbytes`` materialisation)."""
+    import numpy as np
+    size = 1
+    for d in getattr(leaf, "shape", ()):
+        size *= int(d)
+    return size * np.dtype(getattr(leaf, "dtype", np.float32)).itemsize
+
+
+def plan_buckets(tree: Any, bucket_bytes: int) -> BucketPlan:
+    """Greedy reverse-flatten-order packing under ``bucket_bytes``.
+
+    A leaf larger than the bound gets its own bucket (it cannot be
+    split without changing the collective's shape); ``bucket_bytes <= 0``
+    degenerates to one-leaf-per-bucket, the finest legal schedule.
+    """
+    leaves = jax.tree.leaves(tree)
+    sizes = tuple(leaf_nbytes(x) for x in leaves)
+    buckets: List[tuple] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in reversed(range(len(leaves))):
+        if cur and cur_bytes + sizes[i] > max(int(bucket_bytes), 1):
+            buckets.append(tuple(cur))
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += sizes[i]
+    if cur:
+        buckets.append(tuple(cur))
+    return BucketPlan(buckets=tuple(buckets), leaf_bytes=sizes,
+                      bucket_bytes=int(bucket_bytes))
+
+
+def barrier_mean(grads: Any, axis: str) -> Any:
+    """``--grad-overlap off``: the pinned trailing-barrier baseline —
+    every leaf barriered TOGETHER, then per-leaf pmean. No reduce can
+    issue before the whole backward is done (see module docstring for
+    why the baseline must be pinned rather than left to the backend)."""
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+    held = lax.optimization_barrier(tuple(leaves))
+    return jax.tree.unflatten(
+        treedef, [lax.pmean(g, axis) for g in held])
+
+
+def bucketed_mean(grads: Any, axis: str, bucket_bytes: int,
+                  plan: BucketPlan | None = None) -> Any:
+    """``--grad-overlap bucketed``: per-bucket pmeans in backward
+    production order, chained through ``optimization_barrier`` so the
+    combiner cannot re-fuse them and the scheduler cannot sink them
+    (each bucket's inputs are barriered WITH the previous bucket's
+    reduced outputs — a pure ordering edge, zero math)."""
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+    if plan is None:
+        plan = plan_buckets(grads, bucket_bytes)
+    out: List[Any] = [None] * len(leaves)
+    carry: tuple = ()
+    for bucket in plan.buckets:
+        vals = tuple(leaves[i] for i in bucket)
+        if carry:
+            joined = lax.optimization_barrier(vals + carry)
+            vals = joined[:len(vals)]
+        reduced = tuple(lax.pmean(v, axis) for v in vals)
+        for i, r in zip(bucket, reduced):
+            out[i] = r
+        carry = reduced
+    return jax.tree.unflatten(treedef, out)
+
+
+def grad_mean(grads: Any, axis: str, *, mode: str = "off",
+              bucket_bytes: int = 0) -> Any:
+    """The DP engine path's one entry: dispatch on ``--grad-overlap``."""
+    if mode == "bucketed":
+        return bucketed_mean(grads, axis, bucket_bytes)
+    if mode == "off":
+        return barrier_mean(grads, axis)
+    raise ValueError(
+        f"--grad-overlap must be one of {GRAD_OVERLAP_MODES}, "
+        f"got {mode!r}")

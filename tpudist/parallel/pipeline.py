@@ -61,7 +61,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
                     n_microbatches: int = 0, axis: str = "pipe",
                     dtype=jnp.bfloat16, remat: bool = False,
                     xent_chunks: int = 0, fused_xent: bool = False,
-                    unroll_slots: bool = False) -> Callable:
+                    unroll_slots: bool = False,
+                    interleave: int = 1) -> Callable:
     """(params, tokens) -> scalar loss, pipelined over ``axis``.
 
     ``tokens``: (batch, seq+1) int32, replicated over ``axis`` (batch dims
@@ -78,6 +79,21 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
     ``xent_chunks``/``fused_xent``: LM-head strategy, same semantics as
     the dense path (the head runs once on the stacked completed
     microbatches, so all of head_loss's strategies apply unchanged).
+
+    ``interleave`` (v): virtual stages per device — the interleaved
+    schedule ("Scaling Deep Learning Training with MPMD Pipeline
+    Parallelism", PAPERS.md). Each device holds v round-robin layer
+    CHUNKS (chunk c on stage s = global layers of virtual stage
+    c·S+s), a microbatch laps the ring v times, and the slot loop runs
+    v·M+S−1 chunk-slots each costing 1/v of a GPipe slot — the
+    fill/drain bubble shrinks from (S−1)/(M+S−1) to (S−1)/(v·M+S−1)
+    of the step. Same one-SPMD-program philosophy: the ring ppermute
+    structure is IDENTICAL to GPipe's (stage S−1's chunk-c output at
+    slot t−1 is exactly what stage 0 needs for chunk c+1 at slot t),
+    only the ingest/chunk-select masks change; v=1 keeps the GPipe
+    code path bit-for-bit as the parity oracle. Requires
+    ``n_layers % (S·v) == 0`` and microbatches divisible by S (the
+    schedule groups microbatches S at a time per chunk cycle).
     """
     from tpudist.models import moe as MOE
     from tpudist.models import transformer as T
@@ -86,9 +102,13 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
     from tpudist.utils import compat
     compat.check_partial_auto(mesh, axis, "pipeline parallelism")
     n_stages = mesh.shape[axis]
-    if cfg.n_layers % n_stages:
+    v = int(interleave)
+    if v < 1:
+        raise ValueError(f"pipeline interleave must be >= 1, got {v}")
+    if cfg.n_layers % (n_stages * v):
         raise ValueError(
-            f"n_layers={cfg.n_layers} not divisible by pipe={n_stages}")
+            f"n_layers={cfg.n_layers} not divisible by "
+            f"pipe*interleave={n_stages}*{v}")
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
 
     def loss(params: dict, tokens: jax.Array) -> jax.Array:
@@ -104,6 +124,11 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             raise ValueError(
                 f"batch {tokens.shape[0]} not divisible by "
                 f"pp_microbatches={n_micro}")
+        if v > 1 and n_micro % n_stages:
+            raise ValueError(
+                f"pipeline interleave {v} schedules microbatches in "
+                f"groups of pipe={n_stages}; pp_microbatches={n_micro} "
+                f"does not divide")
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         # Gather fsdp/tensor weight shards OUTSIDE the manual region (the
         # SPMD partitioner CHECK-crashes expanding fsdp device groups
@@ -113,12 +138,32 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
         # gathered weights, and this constraint's transpose reduce-
         # scatters the grads back to their shards.
         ns = lambda spec: jax.sharding.NamedSharding(mesh, spec)
+        layers = params["layers"]
+        if v > 1:
+            # interleaved layer layout: device s's CONTIGUOUS pipe
+            # shard must hold its v round-robin chunks (virtual stage
+            # c·S+s, c = 0..v−1) — a permutation of the stacked layer
+            # dim, row (s·v + c)·Lc + l ← global layer (c·S + s)·Lc + l.
+            # Expressed as reshape(v,S,Lc)·transpose(S,v,Lc)·reshape —
+            # NOT a gather: XLA lowers the transpose (and its backward,
+            # the inverse transpose) as a plain copy, where a gather's
+            # transpose is a scatter-add the slot scan would then drag
+            # through every reverse step (measured ~20% step cost).
+            Lc = cfg.n_layers // (n_stages * v)
+
+            def to_interleaved(x):
+                rest = tuple(x.shape[1:])
+                return (x.reshape((v, n_stages, Lc) + rest)
+                        .transpose((1, 0, 2)
+                                   + tuple(range(3, 3 + len(rest))))
+                        .reshape((cfg.n_layers,) + rest))
+            layers = jax.tree.map(to_interleaved, layers)
         params = {
             "embed": jax.lax.with_sharding_constraint(
                 params["embed"], ns(P())),
             "layers": jax.tree.map(
                 lambda x: jax.lax.with_sharding_constraint(
-                    x, ns(P(axis))), params["layers"]),
+                    x, ns(P(axis))), layers),
             "final_norm": params["final_norm"],
         }
         # embedding lookup also hoisted: one gather instead of per-slot
@@ -137,8 +182,8 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             emb = params["embed"].astype(dtype)
             layers_local = params["layers"]     # leading dim n_layers/S
 
-            def run_stage(x):
-                """One stage's layers; returns (x, summed router aux)."""
+            def run_stage(x, layers):
+                """One chunk's layers; returns (x, summed router aux)."""
                 def lbody(carry, lp):
                     x, a = carry
                     if is_moe:
@@ -150,10 +195,9 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
                     return (x, a), None
                 if remat:
                     lbody = jax.checkpoint(lbody)
-                (x, a), _ = lax.scan(lbody,
-                                     (x, jnp.zeros((), jnp.float32)),
-                                     layers_local,
-                                     unroll=cfg.n_layers // n_stages <= 8)
+                (x, a), _ = lax.scan(
+                    lbody, (x, jnp.zeros((), jnp.float32)), layers,
+                    unroll=cfg.n_layers // (n_stages * v) <= 8)
                 return x, a
 
             def slot(carry, t):
@@ -162,7 +206,7 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
                 # t; the last stage completes microbatch t-(S-1)
                 ingest = mb_x[jnp.clip(t, 0, n_micro - 1)]
                 x = jnp.where(stage == 0, ingest, x)
-                x, stage_aux = run_stage(x)
+                x, stage_aux = run_stage(x, layers_local)
                 # this stage holds a REAL microbatch only for slots
                 # [stage, stage + M): bubble-slot aux is garbage
                 holds = (t >= stage) & (t < stage + n_micro)
@@ -171,13 +215,42 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
                 x = lax.ppermute(x, axis, perm)
                 return (x, aux_sum), out
 
+            def slot_interleaved(carry, t):
+                """One CHUNK-slot of the interleaved schedule. Device s
+                at slot t works on the microbatch-group cycle position
+                u = t − s: group q = u // (v·S), chunk c = (u mod v·S)
+                // S, microbatch m = q·S + (u mod S). Stage 0 ingests a
+                FRESH microbatch only at a chunk-0 slot; every other
+                slot it keeps the rotated value — which is stage S−1's
+                chunk c−1 output of the same microbatch, arriving on
+                the very same ring ppermute GPipe uses."""
+                x, aux_sum = carry
+                u = t - stage
+                w = jnp.mod(u, v * n_stages)
+                c = jnp.clip(w // n_stages, 0, v - 1)
+                m = (u // (v * n_stages)) * n_stages + jnp.mod(w, n_stages)
+                ingest = mb_x[jnp.clip(m, 0, n_micro - 1)]
+                x = jnp.where((stage == 0) & (c == 0), ingest, x)
+                chunk = jax.tree.map(
+                    lambda a: a.reshape((v, a.shape[0] // v)
+                                        + a.shape[1:])[c], layers_local)
+                x, stage_aux = run_stage(x, chunk)
+                # a real microbatch occupies this device for cycle
+                # positions [0, v·M): everything else is bubble garbage
+                holds = (u >= 0) & (u < v * n_micro)
+                aux_sum = aux_sum + jnp.where(holds, stage_aux, 0.0)
+                out = x                              # pre-rotation
+                x = lax.ppermute(x, axis, perm)
+                return (x, aux_sum), out
+
             x0 = jnp.zeros((b // n_micro, s, cfg.d_model), dtype)
             zero = jnp.zeros((), jnp.float32)
+            n_slots = v * n_micro + n_stages - 1
             # unroll_slots exists for FLOP accounting in tests: XLA cost
             # analysis counts a scan body once regardless of trip count
             (_, aux_sum), xs = lax.scan(
-                slot, (x0, zero), jnp.arange(n_micro + n_stages - 1),
-                unroll=unroll_slots)
+                slot if v == 1 else slot_interleaved, (x0, zero),
+                jnp.arange(n_slots), unroll=unroll_slots)
             # ONE head per step, outside the slot loop (r3 judge: the old
             # per-slot head cost (M+S-1) head computations per device with
             # all but the last stage's M discarded): on the last stage,
@@ -186,8 +259,20 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             # run the head once over the whole batch. Other stages compute
             # it on bubble garbage in SPMD lockstep (irreducible in a
             # single-program schedule) and are masked out of the psum; the
-            # mask's transpose zeroes their cotangents.
-            hseq = xs[n_stages - 1:].reshape(b, s, cfg.d_model)
+            # mask's transpose zeroes their cotangents. Interleaved:
+            # microbatch m's final chunk (v−1) completes on the last
+            # stage at slot (m//S)·v·S + (v−1)·S + (m mod S) + S−1 — a
+            # static gather in microbatch order replaces the contiguous
+            # slice (and reduces to it at v=1).
+            if v == 1:
+                hseq = xs[n_stages - 1:].reshape(b, s, cfg.d_model)
+            else:
+                import numpy as np
+                done = np.array(
+                    [(m // n_stages) * v * n_stages + (v - 1) * n_stages
+                     + (m % n_stages) + n_stages - 1
+                     for m in range(n_micro)], np.int32)
+                hseq = xs[done].reshape(b, s, cfg.d_model)
             mb_l = T.head_loss(emb, T.rmsnorm(hseq, params["final_norm"]),
                                mb_tgt.reshape(b, s),
                                xent_chunks=xent_chunks,

@@ -53,6 +53,19 @@ def named(mesh: Mesh, spec_tree):
         is_leaf=lambda x: isinstance(x, P))
 
 
+def pure_dp(mesh: Mesh) -> bool:
+    """True when only the ``data`` axis is > 1 — the explicit-collective
+    DP shard_map engine path (engine._build_step_body), and therefore
+    the mesh whose gradient all-reduce the overlap plane
+    (parallel.overlap, ``--grad-overlap``) can schedule. One predicate,
+    shared by the engine's path choice and the tuner's axis gating, so
+    the two cannot disagree about which program a config dispatches."""
+    return (mesh.shape.get("data", 1) > 1
+            and all(mesh.shape.get(a, 1) == 1
+                    for a in ("pipe", "fsdp", "expert", "tensor",
+                              "context")))
+
+
 def batch_spec(ndim: int) -> P:
     """Batch arrays shard their leading (batch) dim over data AND fsdp axes —
     fsdp replicas are extra data-parallel workers for activations."""

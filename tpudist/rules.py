@@ -34,7 +34,14 @@ from typing import Optional, Tuple
 # the rationale comments stay with the consumers that explain them).
 STRAGGLER_FACTOR = 1.25     # verdict.straggler_status
 STAGING_OVERLAP_MIN = 0.5   # verdict.staging_status
-COMM_EXPOSED_MAX = 0.25     # obs.devtime.comm_status
+COMM_EXPOSED_MAX = 0.25     # obs.devtime.comm_status (ICI rows)
+# DCN rows grade against their own ceiling: a cross-slice data axis is
+# an order of magnitude slower than the ICI torus, so the same schedule
+# honestly exposes more of it — flagging a DCN run at the ICI ceiling
+# would read every multi-slice pod as broken, while a DCN pod clearing
+# the ICI bar would mean the overlap plane is idle. Selected per row by
+# resolve_comm() from the devtime record's axis_fabric label.
+COMM_EXPOSED_MAX_DCN = 0.4  # obs.devtime.comm_status (DCN rows)
 REGRESS_MIN_FRACTION = 0.8  # obs.report regression gate
 STALL_TIMEOUT_S = 300.0     # obs.heartbeat watchdog / live stall alert
 TRACE_DROP_MAX = 0.5        # verdict.trace_status (no live alert: a
@@ -102,6 +109,16 @@ THRESHOLDS: Tuple[Threshold, ...] = (
                    "window",
         description="communication the schedule failed to overlap "
                     "with compute"),
+    Threshold(
+        name="comm_dcn", env="TPUDIST_COMM_EXPOSED_MAX_DCN",
+        default=COMM_EXPOSED_MAX_DCN, sense="max", alert=False,
+        observable="exposed-communication fraction of the device "
+                   "window, when the graded axis crosses slices (DCN)",
+        description="the DCN ceiling for the comm gate — not its own "
+                    "alert: the live engine observes rule 'comm' with "
+                    "this threshold substituted (resolve_comm), so "
+                    "mid-run alerts and the at-exit comm_status stay "
+                    "one (rule, host) key per fabric-graded breach"),
     Threshold(
         name="regress", env="TPUDIST_REGRESS_MIN",
         default=REGRESS_MIN_FRACTION, sense="min", alert=True,
@@ -185,6 +202,16 @@ def resolve(name: str) -> float:
         except ValueError:
             pass
     return rule.default
+
+
+def resolve_comm(fabric: Optional[str] = None) -> float:
+    """The exposed-comm ceiling for a fabric-labeled row: ``"dcn"``
+    resolves the ``comm_dcn`` rule (its own env + default), anything
+    else — ``"ici"``, None, an unknown label — the ``comm`` rule. The
+    single fabric-dispatch point every comm-gate consumer (devtime,
+    verdict, live alerts, report) routes through, so ICI and DCN rows
+    cannot drift onto different tables."""
+    return resolve("comm_dcn" if fabric == "dcn" else "comm")
 
 
 def breached(name: str, value: Optional[float],

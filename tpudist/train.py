@@ -238,6 +238,22 @@ def run(cfg: TrainConfig) -> float:
     # workload is dispatch-bound by construction — per-step Python
     # dispatch hides the fabric performance the test is measuring);
     # exactly one of the two step builders is compiled per run
+    overlap_mode, _bucket_bytes = config_lib.resolve_grad_overlap(cfg)
+    # validate even when the mesh has no pipe axis (the pp loss builder
+    # is the real consumer): a typo'd flag must fail fast, not ride
+    # along silently ignored
+    config_lib.resolve_pipeline_interleave(cfg)
+    if overlap_mode != "off":
+        from tpudist.parallel import sharding as shd_lib
+        if shd_lib.pure_dp(mesh):
+            # only claim the schedule when the program will carry it:
+            # the engine keeps the flag inert on single-device meshes
+            # (laptop dry-runs), and this line is what CI greps to
+            # prove the overlap is active — it must not lie there
+            log0(f"tpudist: grad overlap {overlap_mode}: bucket "
+                 f"{_bucket_bytes / 2**20:g} MB over the data axis "
+                 f"(reduce dispatched as backward produces each "
+                 f"bucket)")
     k = config_lib.resolve_steps_per_dispatch(cfg)
     budget_bytes = None
     if k > 1:
@@ -531,13 +547,21 @@ def run(cfg: TrainConfig) -> float:
             with trace_lib.span("devtime_ingest", cat="profile"):
                 analysis = devtime_lib.analyze_capture(win.capture_dir)
             pod = analysis["pod"]
+            # fabric-graded: the gradient all-reduce rides the data
+            # axis, whose ICI/DCN label (mesh.axis_fabric — scripted
+            # slices included) picks the exposed-comm ceiling; the full
+            # per-axis map rides the record for the report/dashboards
+            from tpudist.parallel import mesh as mesh_lib
+            fabric = mesh_lib.data_fabric(mesh)
+            fabrics = mesh_lib.mesh_fabrics(mesh)
             devtime_status = verdict_lib.comm_status(
-                pod["exposed_comm_frac"])
+                pod["exposed_comm_frac"], fabric=fabric)
             dev_events = devtime_lib.device_events(
                 analysis, process_index=ctx.process_index,
                 anchor_us=(win.anchor_ns or 0) / 1e3)
             metrics.log(
                 kind="devtime", comm_status=devtime_status,
+                fabric=fabric, axis_fabric=fabrics,
                 capture=win.capture_dir, dispatches=win.seen,
                 process_index=ctx.process_index, **pod,
                 per_device=[{"device": name, **d}
@@ -547,7 +571,7 @@ def run(cfg: TrainConfig) -> float:
                  f"{pod['comm_s']:.3f}s ({pod['exposed_comm_s']:.3f}s "
                  f"exposed, "
                  f"{100 * (pod['exposed_comm_frac'] or 0):.1f}% of the "
-                 f"{pod['window_s']:.3f}s window) over "
+                 f"{pod['window_s']:.3f}s window, {fabric}-graded) over "
                  f"{pod['devices']} device track(s)")
         except Exception as e:
             devtime_status = verdict_lib.FAIL

@@ -62,11 +62,15 @@ def _heuristic_candidate(cfg, *, state_bytes: int = 0,
     floor the commit may never fall below."""
     budget = config_lib.resolve_staging_budget_bytes(
         cfg, state_bytes=state_bytes, hbm_bytes=hbm_bytes)
+    mode, bucket_bytes = config_lib.resolve_grad_overlap(cfg)
     return Candidate(
         k=config_lib.resolve_steps_per_dispatch(cfg),
         staging_budget_mb=(None if budget is None
                            else round(budget / 2**20, 4)),
-        remat=cfg.remat, grad_accum_steps=cfg.grad_accum_steps)
+        remat=cfg.remat, grad_accum_steps=cfg.grad_accum_steps,
+        grad_bucket_mb=(round(bucket_bytes / 2**20, 4)
+                        if mode == "bucketed" else None),
+        pipeline_interleave=config_lib.resolve_pipeline_interleave(cfg))
 
 
 def _sync_candidate(cand: Optional[Candidate],
@@ -88,6 +92,9 @@ def _sync_candidate(cand: Optional[Candidate],
         else float(cand.staging_budget_mb),
         1.0 if (cand and cand.remat) else 0.0,
         float(cand.grad_accum_steps if cand else 0),
+        -1.0 if (cand is None or cand.grad_bucket_mb is None)
+        else float(cand.grad_bucket_mb),
+        float(cand.pipeline_interleave if cand else 0),
     ], np.float64)
     dec = multihost_utils.broadcast_one_to_all(enc)
     if dec[1] < 0.5:
@@ -96,7 +103,9 @@ def _sync_candidate(cand: Optional[Candidate],
         k=int(dec[2]),
         staging_budget_mb=(None if dec[3] < 0 else float(dec[3])),
         remat=bool(dec[4] > 0.5),
-        grad_accum_steps=int(dec[5])), bool(dec[0] > 0.5)
+        grad_accum_steps=int(dec[5]),
+        grad_bucket_mb=(None if dec[6] < 0 else float(dec[6])),
+        pipeline_interleave=int(dec[7])), bool(dec[0] > 0.5)
 
 
 def _sync_result(res: "probe_mod.ProbeResult") -> "probe_mod.ProbeResult":
@@ -147,7 +156,10 @@ def autotune(cfg, mesh, plan, *, mode: str, metrics: Any = None,
         tuned = Candidate(k=int(t["k"]),
                           staging_budget_mb=t["staging_budget_mb"],
                           remat=bool(t["remat"]),
-                          grad_accum_steps=int(t["grad_accum_steps"]))
+                          grad_accum_steps=int(t["grad_accum_steps"]),
+                          grad_bucket_mb=t.get("grad_bucket_mb"),
+                          pipeline_interleave=int(
+                              t.get("pipeline_interleave") or 0))
         hit = True
     tuned, hit = _sync_candidate(tuned, hit)
     if hit and tuned is not None:
@@ -225,9 +237,14 @@ def _probe_search(cfg, mesh, plan, start: Candidate, *, trials_budget: int,
     trial instead of re-measuring the identical program."""
     batch_ways = max(
         mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1), 1)
+    # the overlap-plane axes only exist where the mesh makes them real:
+    # bucket bytes on the explicit-DP mesh, virtual stages on pipe > 1
+    from tpudist.parallel import sharding as shd
     axes = search_mod.build_space(
         cfg, batch_ways=batch_ways,
-        heuristic_budget_mb=start.staging_budget_mb)
+        heuristic_budget_mb=start.staging_budget_mb,
+        dp_overlap=shd.pure_dp(mesh),
+        pipe_stages=mesh.shape.get("pipe", 1))
     by_key: Dict[tuple, probe_mod.ProbeResult] = {}
 
     def raw_probe(cand: Candidate) -> probe_mod.ProbeResult:
@@ -299,6 +316,8 @@ def _log_record(out: TuneOutcome, metrics: Any) -> TuneOutcome:
                     staging_budget_mb=out.tuned.staging_budget_mb,
                     remat=out.tuned.remat,
                     grad_accum_steps=out.tuned.grad_accum_steps,
+                    grad_bucket_mb=out.tuned.grad_bucket_mb,
+                    pipeline_interleave=out.tuned.pipeline_interleave,
                     steps_per_sec=out.steps_per_sec,
                     baseline_steps_per_sec=out.baseline_steps_per_sec)
     return out

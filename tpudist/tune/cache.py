@@ -27,6 +27,16 @@ from typing import Any, Dict, Optional
 SCHEMA = 1
 
 
+def config_grad_overlap_mode(cfg) -> str:
+    """The resolved ``--grad-overlap`` mode for the fingerprint (env
+    included — the same resolution the engine dispatches on)."""
+    from tpudist.config import resolve_grad_overlap
+    try:
+        return resolve_grad_overlap(cfg)[0]
+    except ValueError:
+        return "off"
+
+
 def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
     """Hex fingerprint of the tuning situation (see module docstring)."""
     import jax
@@ -45,6 +55,11 @@ def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
         "adam_nu_dtype": cfg.adam_nu_dtype,
         "log_every": cfg.log_every,
         "ckpt_every_steps": cfg.ckpt_every_steps,
+        # the overlap plane changes the PROGRAM the knobs tune: a cache
+        # entry measured with the barrier all-reduce must not serve a
+        # bucketed run (and the search space itself differs)
+        "grad_overlap": config_grad_overlap_mode(cfg),
+        "pp_microbatches": cfg.pp_microbatches,
         "mesh": dict(zip(mesh.axis_names,
                          (int(s) for s in mesh.devices.shape))),
         "n_devices": jax.device_count(),
@@ -62,9 +77,12 @@ def cache_path(cache_dir: str, fp: str, prefix: str = "tune") -> str:
 
 
 def _validate_train_tuned(tuned: Dict[str, Any]) -> bool:
-    """The train tuner's knob sanity check: the four knobs must all be
+    """The train tuner's knob sanity check: the knobs must all be
     present and sane — an insane value (wrong type, non-positive) is a
-    MISS here, not a crash later in resolve_staging_budget_bytes."""
+    MISS here, not a crash later in resolve_staging_budget_bytes. The
+    overlap-plane coordinates (grad_bucket_mb, pipeline_interleave) are
+    validated when present; entries written before they existed are
+    already invalidated by the fingerprint's grad_overlap/pp fields."""
     if int(tuned["k"]) < 1 or int(tuned["grad_accum_steps"]) < 1:
         return False
     bool(tuned["remat"])
@@ -72,6 +90,14 @@ def _validate_train_tuned(tuned: Dict[str, Any]) -> bool:
     if budget is not None and (isinstance(budget, bool)
                                or not isinstance(budget, (int, float))
                                or budget <= 0):
+        return False
+    bucket = tuned.get("grad_bucket_mb")
+    if bucket is not None and (isinstance(bucket, bool)
+                               or not isinstance(bucket, (int, float))
+                               or bucket <= 0):
+        return False
+    v = tuned.get("pipeline_interleave")
+    if v is not None and int(v) < 0:
         return False
     return True
 

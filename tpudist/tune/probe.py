@@ -174,8 +174,10 @@ def candidate_key(cfg, mesh, candidate, plan, n_steps: int) -> tuple:
     budget is spent on points that can actually differ. Raises where the
     plan itself is infeasible (plan_slabs's double-buffer error), which
     the caller converts to a pruned point."""
+    overlap = (candidate.grad_bucket_mb, candidate.pipeline_interleave)
     if candidate.k == 1:
-        return (1, None, candidate.remat, candidate.grad_accum_steps)
+        return (1, None, candidate.remat, candidate.grad_accum_steps,
+                overlap)
     pcfg = candidate.apply(cfg)
     budget = config_lib.resolve_staging_budget_bytes(pcfg)
     n = min(int(n_steps), plan.n_steps)
@@ -185,7 +187,7 @@ def candidate_key(cfg, mesh, candidate, plan, n_steps: int) -> tuple:
                      // batch_shards)
     splan = shd.plan_slabs(n, candidate.k, step_bytes, budget)
     return (candidate.k, (splan.slab_steps, splan.streamed),
-            candidate.remat, candidate.grad_accum_steps)
+            candidate.remat, candidate.grad_accum_steps, overlap)
 
 
 def probe_candidate(cfg, mesh, candidate, plan, *,

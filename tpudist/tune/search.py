@@ -38,8 +38,13 @@ from tpudist.config import SUPERSTEP_CAP, TrainConfig
 
 # Axis walk order: the k axis carries the order-of-magnitude spread
 # (BENCH_DISPATCH), so it is searched first and every later axis rides
-# the committed k.
-AXES = ("k", "staging_budget_mb", "remat", "grad_accum_steps")
+# the committed k. The overlap-plane knobs (grad bucket bytes, pipeline
+# virtual stages) sit between the dispatch knobs and the math knobs:
+# both are pure SCHEDULE coordinates — bitwise-identical loss at every
+# value (parallel.overlap / parallel.pipeline pin this) — so they never
+# need the math-axis commit margin, just a measured win.
+AXES = ("k", "staging_budget_mb", "grad_bucket_mb",
+        "pipeline_interleave", "remat", "grad_accum_steps")
 
 # Axes where the knob monotonically raises memory/recompute pressure:
 # an infeasible point stops the ascent instead of probing bigger ones.
@@ -79,12 +84,23 @@ class Candidate:
     staging_budget_mb: Optional[float] = None
     remat: bool = False
     grad_accum_steps: int = 1
+    # overlap-plane coordinates (None / 0 = leave cfg's setting alone —
+    # the axes only enter the space when the run's mesh makes them real)
+    grad_bucket_mb: Optional[float] = None
+    pipeline_interleave: int = 0
 
     def apply(self, cfg: TrainConfig) -> TrainConfig:
-        return dataclasses.replace(
+        out = dataclasses.replace(
             cfg, steps_per_dispatch=self.k,
             staging_budget_mb=self.staging_budget_mb,
             remat=self.remat, grad_accum_steps=self.grad_accum_steps)
+        if self.grad_bucket_mb is not None:
+            out = dataclasses.replace(out,
+                                      grad_bucket_mb=self.grad_bucket_mb)
+        if self.pipeline_interleave:
+            out = dataclasses.replace(
+                out, pipeline_interleave=self.pipeline_interleave)
+        return out
 
     def replace(self, **kw) -> "Candidate":
         return dataclasses.replace(self, **kw)
@@ -119,8 +135,18 @@ def k_candidates(cfg: TrainConfig) -> List[int]:
     return ladder
 
 
+# Bucket-size ladder for --grad-overlap bucketed, MB: geometric like the
+# k ladder, spanning "reduce almost per-leaf" to "one bucket ≈ barrier".
+GRAD_BUCKET_LADDER_MB = (1.0, 4.0, 16.0)
+
+# Interleave ladder: geometric virtual-stage counts, filtered to what
+# the model's layer count divides into (build_space).
+PIPELINE_INTERLEAVE_LADDER = (1, 2, 4, 8)
+
+
 def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
-                heuristic_budget_mb: Optional[float] = None
+                heuristic_budget_mb: Optional[float] = None,
+                dp_overlap: bool = False, pipe_stages: int = 1
                 ) -> Dict[str, List[Any]]:
     """The bounded search space for this run's config.
 
@@ -128,11 +154,21 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
     * ``staging_budget_mb``: the heuristic estimate, unbounded (the
       full-epoch fast path), and 2x the estimate — only when a heuristic
       estimate exists at all.
+    * ``grad_bucket_mb``: the geometric bucket ladder, led by the run's
+      configured value — only when ``dp_overlap`` says the mesh has an
+      explicit DP all-reduce AND ``--grad-overlap bucketed`` is on (a
+      bucket size is meaningless otherwise).
+    * ``pipeline_interleave``: virtual-stage counts the layer count
+      divides into — only on pipeline meshes (``pipe_stages > 1``) with
+      auto microbatching or an S-divisible explicit M (the interleaved
+      schedule groups microbatches S at a time).
     * ``remat``: both settings for layered models; the mlp has no layers
       to checkpoint.
     * ``grad_accum_steps``: {1, 2, 4} filtered to divide the per-shard
       batch (the same divisibility train.run enforces).
     """
+    from tpudist.config import (resolve_grad_overlap,
+                                resolve_pipeline_interleave)
     budgets: List[Optional[float]] = [heuristic_budget_mb]
     if heuristic_budget_mb is not None:
         budgets += [None, round(heuristic_budget_mb * 2, 4)]
@@ -141,9 +177,27 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
            if cfg.batch_size % (max(batch_ways, 1) * g) == 0]
     if cfg.grad_accum_steps not in gas:
         gas = sorted(set(gas) | {cfg.grad_accum_steps})
+    buckets: List[Optional[float]] = []
+    mode, bucket_bytes = resolve_grad_overlap(cfg)
+    if dp_overlap and mode == "bucketed":
+        lead = round(bucket_bytes / 2**20, 4)
+        buckets = [lead] + [b for b in GRAD_BUCKET_LADDER_MB if b != lead]
+    interleaves: List[int] = []
+    if pipe_stages > 1 and layered:
+        v0 = resolve_pipeline_interleave(cfg)
+        micro_ok = (cfg.pp_microbatches == 0
+                    or cfg.pp_microbatches % pipe_stages == 0)
+        if micro_ok:
+            interleaves = [
+                v for v in PIPELINE_INTERLEAVE_LADDER
+                if cfg.model.n_layers % (pipe_stages * v) == 0]
+            if v0 in interleaves:   # lead with the configured value
+                interleaves = [v0] + [v for v in interleaves if v != v0]
     return {
         "k": k_candidates(cfg),
         "staging_budget_mb": budgets,
+        "grad_bucket_mb": buckets,
+        "pipeline_interleave": interleaves,
         "remat": ([cfg.remat, not cfg.remat] if layered else [cfg.remat]),
         "grad_accum_steps": gas,
     }

@@ -169,17 +169,21 @@ def resume_status(requested: bool, restored: bool,
     return SUCCESS if restored else UNGATEABLE
 
 
-def comm_status(exposed_frac, max_frac: float | None = None) -> str:
+def comm_status(exposed_frac, max_frac: float | None = None,
+                fabric: str | None = None) -> str:
     """Three-valued exposed-communication verdict (tpudist.obs.devtime,
     ``--profile-window`` capture): UNGATEABLE with no device window
     measured, else SUCCESS/FAIL by whether the exposed-comm fraction
-    stays under ``TPUDIST_COMM_EXPOSED_MAX``. The implementation lives
-    in obs.devtime next to the interval math that produces the
-    fraction; this delegator keeps the train loop's verdict surface in
-    one place like the other gates. (Lazy import: devtime imports this
-    module for the status vocabulary.)"""
+    stays under the fabric's ceiling — ``TPUDIST_COMM_EXPOSED_MAX`` for
+    ICI rows, ``TPUDIST_COMM_EXPOSED_MAX_DCN`` when the graded axis
+    crosses slices (``fabric="dcn"``, from the mesh's axis_fabric
+    labeling). The implementation lives in obs.devtime next to the
+    interval math that produces the fraction; this delegator keeps the
+    train loop's verdict surface in one place like the other gates.
+    (Lazy import: devtime imports this module for the status
+    vocabulary.)"""
     from tpudist.obs.devtime import comm_status as _impl
-    return _impl(exposed_frac, max_frac)
+    return _impl(exposed_frac, max_frac, fabric=fabric)
 
 
 # Goodput gate (tpudist.obs.goodput): productive training time as a
