@@ -988,6 +988,30 @@ def run_chaos_drill(out_path: str) -> dict:
     return art
 
 
+# ----------------------------------------------------- serve chaos drill
+
+
+def run_serve_chaos_drill(out_path: str) -> dict:
+    """The serve-resilience headline: drive the scripted overload +
+    serve fault matrix (tpudist.serve.drill — bounded-queue shedding
+    with the arrival partition checked exactly, serve_kill → policy →
+    requeue → resume with in-flight slots honestly lost, garbage
+    rejection, straggler stall, adapt ladder) and write
+    BENCH_SERVE_RESILIENCE.json on the BENCH_* harness shape. The
+    measurement half is the jax-free verifier's report: how many
+    scenarios ended green, with per-scenario shed/resume facts in the
+    detail block. A thin shaper like run_chaos_drill — serve.drill
+    owns the orchestration and artifact shape (one source for its CLI,
+    this flag and selfcheck check_serve_resilience)."""
+    from tpudist.serve import drill as serve_drill
+
+    art = serve_drill.bench_artifact(serve_drill.run_and_verify())
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({k: art[k] for k in ("metric", "value", "unit")}))
+    return art
+
+
 # ------------------------------------------------------------------ matrix
 
 # (model, seq, head, flash, per_chip[, remat]) — meaningful cells only:
@@ -1195,6 +1219,17 @@ def main() -> None:
                         "fault families ending green")
     p.add_argument("--chaos-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_CHAOS.json"))
+    p.add_argument("--serve-chaos-drill", action="store_true",
+                   help="run the serve resilience matrix "
+                        "(tpudist.serve.drill: 2x-overload shedding "
+                        "with exact partition + bitwise determinism, "
+                        "serve_kill->requeue->resume, request_garbage "
+                        "rejection, serve_slow, adapt ladder) and "
+                        "write BENCH_SERVE_RESILIENCE.json — headline "
+                        "= resilience scenarios ending green")
+    p.add_argument("--serve-chaos-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_SERVE_RESILIENCE.json"))
     p.add_argument("--cell", type=str, default=None,
                    help="internal: run one matrix cell "
                         "(model:seq:head:flash:per_chip:remat)")
@@ -1235,6 +1270,9 @@ def main() -> None:
         return
     if args.chaos_drill:
         run_chaos_drill(args.chaos_out)
+        return
+    if args.serve_chaos_drill:
+        run_serve_chaos_drill(args.serve_chaos_out)
         return
     if args.matrix:
         run_matrix(max(20, args.iters // 2), args.matrix_out, args.moe_group)

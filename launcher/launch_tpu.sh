@@ -32,10 +32,18 @@
 #                       metrics-derived report (the serving section of
 #                       python -m tpudist.obs.report). Extra flags are
 #                       passed to the serve CLI (--requests,
-#                       --request-rate, --serve-tune probe, ...).
-#                       Requeue (MAX_REQUEUES) stays a train-lane
-#                       feature: a serve run has no checkpoint to
-#                       resume, so a failed serve run just stops.
+#                       --request-rate, --serve-tune probe,
+#                       --queue-cap, --ttft-deadline-ms, ...).
+#                       Serve failures flow through the SAME
+#                       policy→backoff→requeue loop as training
+#                       (MAX_REQUEUES): a preemption-shaped exit is
+#                       requeued and the serve CLI's --requeue-attempt
+#                       replays the still-live queued requests from
+#                       the seeded schedule, classifying the dead
+#                       attempt's in-flight slots as lost (no
+#                       checkpoint needed — the request stream IS the
+#                       resumable state); a deterministic crash still
+#                       stops immediately.
 #   RUNTIME_VERSION     TPU software version (default v2-alpha-tpuv5)
 #   IMAGE               docker image to run (default: install this repo's
 #                       package on each worker and run bare python)
@@ -117,9 +125,6 @@ POLL_S="${POLL_S:-10}"   # provisioning poll interval (tests shrink it)
 SWEEP_MIN_PCT="${SWEEP_MIN_PCT:-90}"
 GCS_SWEEP_VERDICT="${GCS_SWEEP_VERDICT:-${GCS_VERDICT}.sweep}"
 MAX_REQUEUES="${MAX_REQUEUES:-0}"
-# requeue stays a train-lane feature: a serve run has no checkpoint to
-# resume from, so a failed serve run stops instead of looping
-[ "$MODE" = "serve" ] && MAX_REQUEUES=0
 REQUEUE_BACKOFF_S="${REQUEUE_BACKOFF_S:-10}"
 # Requeue jitter: a zone-wide capacity event preempts EVERY pod of a
 # fleet at once, and identical exponential backoffs would march all
@@ -423,18 +428,25 @@ while :; do
       probe_slice
     fi
   fi
-  # --resume auto only under an explicit requeue budget: the
-  # pre-elastic contract (every launch trains from scratch) holds
-  # unless the operator opted into elasticity
+  # resume flags only under an explicit requeue budget: the
+  # pre-elastic contract (every launch runs from scratch) holds
+  # unless the operator opted into elasticity. Train resumes from the
+  # last committed manifest; serve resumes from its own flushed
+  # per-request outcome records (the seeded stream minus what a prior
+  # attempt already finished, in-flight slots classified lost).
   RESUME_FLAGS=""
-  if [ "$MODE" = "train" ] && [ "$MAX_REQUEUES" -gt 0 ]; then
-    RESUME_FLAGS=" --resume auto --requeue-attempt $attempt"
+  if [ "$MAX_REQUEUES" -gt 0 ]; then
+    if [ "$MODE" = "train" ]; then
+      RESUME_FLAGS=" --resume auto --requeue-attempt $attempt"
+    else
+      RESUME_FLAGS=" --requeue-attempt $attempt"
+    fi
   fi
   if [ "$MODE" = "serve" ]; then
     # the serving acceptance lane: artifacts land in OBS_DIR so the
     # one collection path below covers them (metrics + trace + bench)
     WORKLOAD="python3 -m tpudist.serve --save-dir $OBS_DIR/serve \
-    --bench-out $OBS_DIR/BENCH_SERVE.json --trace-dir $OBS_DIR"
+    --bench-out $OBS_DIR/BENCH_SERVE.json --trace-dir $OBS_DIR$RESUME_FLAGS"
   else
     WORKLOAD="python3 -m tpudist.train \
     --heartbeat-dir $OBS_DIR --trace-dir $OBS_DIR$RESUME_FLAGS"

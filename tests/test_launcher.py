@@ -61,6 +61,16 @@ case "$*" in
       fi
       exit "${STUB_TRAIN_RC:-0}"
     elif [[ "$*" == *"-m tpudist.serve"* ]]; then
+      # serve requeue drills mirror the train ones: fail the first
+      # STUB_SERVE_FAIL_N attempts with STUB_SERVE_RC, then succeed
+      if [ -n "${STUB_SERVE_FAIL_N:-}" ]; then
+        n=$(cat "$STUB_DIR/serve_n" 2>/dev/null || echo 0)
+        echo $((n+1)) > "$STUB_DIR/serve_n"
+        if [ "$n" -lt "$STUB_SERVE_FAIL_N" ]; then
+          exit "${STUB_SERVE_RC:-137}"
+        fi
+        exit 0
+      fi
       exit "${STUB_SERVE_RC:-0}"
     elif [[ "$*" == *"tpudist.bench.sweep"* ]]; then
       exit "${STUB_SWEEP_RC:-0}"
@@ -561,17 +571,62 @@ def test_serve_mode_runs_serve_workload_and_pulls_bench(stub_env):
         "--metrics /tmp/tpudist_obs/serve/metrics.jsonl" in reports[0]
 
 
-def test_serve_mode_failure_is_never_requeued(stub_env):
-    """A failed serve run stops even with a requeue budget and a
-    preemption-shaped exit code: there is no checkpoint to resume, so
-    requeue stays a train-lane feature."""
+def test_serve_requeue_on_preemption_then_success(stub_env):
+    """PR-15 satellite: MODE=serve failures ride the SAME policy →
+    backoff → requeue loop as training. A signal-killed serve run
+    (rc=137) with a budget reruns with an incremented
+    --requeue-attempt (the serve CLI's replay-the-remaining-stream
+    resume — no --resume flag, serving has no checkpoint), the second
+    attempt yields a green verdict, and attempts.jsonl stamps both
+    invocations with the policy's verdicts."""
+    import json as json_mod
+    env, stub = stub_env
+    env.update(MODE="serve", MAX_REQUEUES="2", REQUEUE_BACKOFF_S="0",
+               STUB_SERVE_FAIL_N="1", STUB_SERVE_RC="137",
+               RUN_ID="r-serve-rq-1")
+    r = launch(env)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert verdict(stub) == "success"
+    assert "VERDICT=preemption REQUEUE=1" in r.stdout, r.stdout
+    serves = _serve_lines(stub)
+    assert len(serves) == 2, serves
+    assert "--requeue-attempt 0" in serves[0]
+    assert "--requeue-attempt 1" in serves[1]
+    assert not any("--resume" in s for s in serves), \
+        "serve has no checkpoint; resume is the replayed stream"
+    recs = [json_mod.loads(ln) for ln in
+            (stub / "flightrec_artifacts" / "attempts.jsonl")
+            .read_text().splitlines()]
+    assert [a["attempt"] for a in recs] == [0, 1]
+    assert recs[0]["rc"] == 137 and recs[0]["verdict"] == "preemption"
+    assert recs[1]["rc"] == 0 and recs[1]["verdict"] == "success"
+    assert all(a["mode"] == "serve" for a in recs)
+
+
+def test_serve_crash_is_not_requeued_even_with_budget(stub_env):
+    """rc=1 from the serve CLI (an SLO fail or a real crash) with no
+    preemption evidence is deterministic: the policy stops immediately
+    — a requeue budget must not buy a serve crash-loop."""
     env, stub = stub_env
     env.update(MODE="serve", MAX_REQUEUES="3", REQUEUE_BACKOFF_S="0",
-               STUB_SERVE_RC="137")
+               STUB_SERVE_RC="1")
     r = launch(env)
     assert r.returncode == 1
     assert verdict(stub) == "fail"
+    assert "VERDICT=crash REQUEUE=0" in r.stdout, r.stdout
     assert len(_serve_lines(stub)) == 1
+
+
+def test_serve_no_requeue_flags_without_budget(stub_env):
+    """Without MAX_REQUEUES the serve command carries no
+    --requeue-attempt: the pre-elastic contract holds until the
+    operator opts in (and a first attempt must not accidentally
+    trigger the CLI's resume-replay path)."""
+    env, stub = stub_env
+    env["MODE"] = "serve"
+    r = launch(env)
+    assert r.returncode == 0, r.stderr
+    assert "--requeue-attempt" not in _serve_lines(stub)[0]
 
 
 def test_bad_mode_rejected(stub_env):

@@ -58,7 +58,7 @@ def test_rules_table_names_and_alert_subset():
     names = {t.name for t in rules_lib.THRESHOLDS}
     assert names == {"straggler", "staging", "comm", "comm_dcn",
                      "regress", "stall", "trace_drop", "ttft", "itl",
-                     "tokens_per_chip", "goodput"}
+                     "tokens_per_chip", "serve_shed", "goodput"}
     # every rule but the artifact-quality one and the DCN threshold row
     # is a live alert (comm_dcn is a per-fabric CEILING the comm alert
     # substitutes via resolve_comm, not its own (rule, host) key — the
@@ -562,9 +562,11 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     agg.ingest({"kind": "stall_dump", "process_index": 0,
                 "stall_s": stall}, now=clk.t)
     # a serving run whose exit verdict would grade every SLO gate fail
-    ttft, itl, tps_chip = 99.0, 99.0, 0.01
+    # (shed_fraction past the admission ceiling included)
+    ttft, itl, tps_chip, shed = 99.0, 99.0, 0.01, 0.95
     agg.ingest({"kind": "serve_tick", "ttft_p99_s": ttft,
-                "itl_p99_s": itl, "tokens_per_sec_per_chip": tps_chip},
+                "itl_p99_s": itl, "tokens_per_sec_per_chip": tps_chip,
+                "shed_fraction": shed},
                now=clk.t)
     # a run-end goodput estimate under the floor (obs.goodput)
     goodput_frac = 0.1
@@ -584,6 +586,9 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     assert stall > 5.0               # the watchdog's own dump condition
     assert verdict_lib.serve_status(ttft, itl, tps_chip) \
         == verdict_lib.FAIL
+    from tpudist.serve import slo as slo_lib
+    assert slo_lib.grade(ttft, itl, tps_chip, shed_fraction=shed)[
+        "serve_shed_status"] == verdict_lib.FAIL
     assert verdict_lib.goodput_status(goodput_frac) == verdict_lib.FAIL
     assert agg.snapshot()["pod"]["goodput_fraction"] == goodput_frac
     agg.close()
@@ -639,6 +644,7 @@ tpudist_alert_firing{alert="stall"} 1
 tpudist_alert_firing{alert="ttft"} 0
 tpudist_alert_firing{alert="itl"} 0
 tpudist_alert_firing{alert="tokens_per_chip"} 0
+tpudist_alert_firing{alert="serve_shed"} 0
 tpudist_alert_firing{alert="goodput"} 0
 # HELP tpudist_alerts_total Alert fire/resolve transitions so far.
 # TYPE tpudist_alerts_total counter

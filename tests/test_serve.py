@@ -579,8 +579,11 @@ def test_report_serving_section_and_verdict():
     rep = report_lib.build_report(_serve_metrics(), {})
     sv = rep["serving"]
     assert sv["enabled"] and sv["status"] == "success"
+    # serve_shed reads ungateable on a pre-resilience record (no
+    # shed_fraction measured) — never a retroactive fail
     assert sv["gates"] == {"ttft": "success", "itl": "success",
-                           "tokens_per_chip": "success"}
+                           "tokens_per_chip": "success",
+                           "serve_shed": "ungateable"}
     assert sv["queue_over_time"][0]["queue_depth"] == 3
     assert rep["verdict"] == report_lib.SUCCESS
     assert rep["schema"] == report_lib.REPORT_SCHEMA_VERSION  # >=5 adds

@@ -1,6 +1,6 @@
-"""ChaosRuntime: fires a parsed fault schedule into a live training run.
+"""ChaosRuntime: fires a parsed fault schedule into a live run.
 
-Two injection surfaces, matching the two places real failures land:
+Three injection surfaces, matching the places real failures land:
 
   * the **step boundary** (``on_step``) — the train loop calls it where
     it already checks ``TPUDIST_TEST_KILL``; kill/hang/slow/
@@ -8,7 +8,15 @@ Two injection surfaces, matching the two places real failures land:
   * the **checkpoint write path** (``ckpt_fault``) — installed as
     :mod:`tpudist.elastic.ckpt`'s module-level fault hook; shard
     corruption, torn-manifest kills and transient filesystem errors
-    fire inside ``ShardedCheckpointer._write`` at named points.
+    fire inside ``ShardedCheckpointer._write`` at named points;
+  * the **serve dispatch boundary** (``on_serve_dispatch``) — the
+    serving scheduler calls it before every decode dispatch;
+    serve_kill dies there (a compiled program is never torn
+    mid-flight) and serve_slow stalls the dispatch, returning the
+    injected seconds so the drill's virtual clock can account them.
+    (The third serve family, ``request_garbage``, is consumed at
+    stream construction — the serve CLI folds the plan's malformed
+    requests into the arrival schedule; admission rejects them.)
 
 Every fired event is logged as a flushed ``kind=chaos`` metrics record
 BEFORE its effect lands (a kill must not eat its own evidence), and the
@@ -56,6 +64,7 @@ class ChaosRuntime:
         # reads and a loop over a cached (usually tiny) tuple
         self._step_events = plan.step_events
         self._ckpt_events = plan.ckpt_events
+        self._serve_events = plan.serve_events
         # per-event mutable state: {"done": bool, "count": int,
         # "bound": (epoch, step) for ckpt events, "remaining": int}
         self._state: Dict[int, Dict[str, Any]] = {
@@ -153,6 +162,61 @@ class ChaosRuntime:
                 settle = time.monotonic() + 0.2   # frames still in flight
             self._sleep(0.05)
         self._die(event, int(event.args.get("rc", 137)))
+
+    # ---------------------------------------------------- serve surface
+    def on_serve_dispatch(self, dispatch: int) -> float:
+        """Called by the serving scheduler before decode dispatch
+        ``dispatch`` (0-based; the trigger's step coordinate, epoch
+        fixed at 0). Returns the seconds of stall injected into THIS
+        dispatch (serve_slow) so a virtual-clock drill can account the
+        delay it just ate; serve_kill never returns. No events → two
+        attribute reads and out, same as the step surface."""
+        injected = 0.0
+        for ev in self._serve_events:
+            st = self._state[ev.index]
+            if st.get("done"):
+                continue
+            if not ev.matches(0, dispatch, self.process_index):
+                continue
+            if ev.kind == "serve_slow":
+                if not st.get("count"):
+                    self._record(ev, at_dispatch=dispatch)
+                st["count"] = st.get("count", 0) + 1
+                s = float(ev.args.get("s", 0.05))
+                self._sleep(s)
+                injected += s
+                if st["count"] >= int(ev.args.get("steps", 1)):
+                    st["done"] = True
+                continue
+            if ev.kind == "serve_kill":
+                st["done"] = True
+                self._record(ev, at_dispatch=dispatch)
+                # rc 137 by default — the preemption reaper's SIGKILL
+                # code, which the jax-free requeue policy classifies
+                # from the exit code alone (the serve lane ships no
+                # heartbeat beacons for the vanished-worker inference)
+                self._die(ev, int(ev.args.get("rc", 137)))
+            # request_garbage is not a dispatch-surface event: the CLI
+            # consumed it when it built the arrival stream
+        return injected
+
+    def consume_request_garbage(self) -> list:
+        """Mark every ``request_garbage`` event fired and return it:
+        the serve CLI calls this ONCE while building the arrival
+        stream (the fault's effect is the malformed requests
+        themselves), so the flushed ``kind=chaos`` evidence lands
+        before the first of them arrives."""
+        out = []
+        for ev in self._serve_events:
+            if ev.kind != "request_garbage":
+                continue
+            st = self._state[ev.index]
+            if st.get("done"):
+                continue
+            st["done"] = True
+            self._record(ev, n=int(ev.args.get("n", 4)))
+            out.append(ev)
+        return out
 
     # ----------------------------------------------- checkpoint surface
     def ckpt_fault(self, point: str, *, step: int, epoch: int,

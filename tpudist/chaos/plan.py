@@ -33,6 +33,11 @@ The seven fault families and their knobs:
   * ``telemetry_garbage`` — inject ``n`` seeded garbage bytes into the
     live-telemetry stream mid-run.
 
+Plus the three serve-surface families (:data:`SERVE_KINDS` —
+``serve_kill`` / ``serve_slow`` / ``request_garbage``), fired into the
+serving loop's dispatch boundaries and arrival stream instead
+(:meth:`tpudist.chaos.inject.ChaosRuntime.on_serve_dispatch`).
+
 Rank ``-1`` (the default) matches every rank. Triggers use ``step >=``
 semantics like ``TPUDIST_TEST_KILL`` (superstep dispatch may cross the
 exact step); every event fires exactly once — the checkpoint-path
@@ -54,8 +59,26 @@ from typing import Any, Dict, List, Optional, Tuple
 FAULT_KINDS = ("kill", "hang", "slow", "corrupt_shard", "torn_manifest",
                "fs_error", "telemetry_garbage")
 
+# The serve-surface families (PR 15): the same grammar, fired into the
+# serving loop instead of the train loop. The trigger's coordinates
+# reinterpret as (epoch=0, step=decode-dispatch index):
+#
+#   * ``serve_kill``      — hard preemption at a decode-dispatch
+#     boundary (``rc``, default 137 — the preemption reaper's SIGKILL
+#     code, so the jax-free requeue policy classifies it without the
+#     train lane's beacon machinery);
+#   * ``serve_slow``      — per-decode-dispatch stall: ``s`` seconds on
+#     each of ``steps`` consecutive dispatches (a straggler chip / a
+#     noisy neighbor on the serving pod);
+#   * ``request_garbage`` — ``n`` seeded MALFORMED requests injected
+#     into the arrival stream (out-of-range tokens, dead budgets, wrong
+#     shapes/dtypes — tpudist.serve.scheduler.make_garbage_requests);
+#     admission must reject every one, the engine must never see them.
+SERVE_KINDS = frozenset({"serve_kill", "serve_slow", "request_garbage"})
+ALL_KINDS = FAULT_KINDS + tuple(sorted(SERVE_KINDS))
+
 # Events that fire at train-step boundaries vs inside the checkpoint
-# write path (the two injection surfaces the runtime wires).
+# write path (the two injection surfaces the train runtime wires).
 STEP_KINDS = frozenset({"kill", "hang", "slow", "telemetry_garbage"})
 CKPT_KINDS = frozenset({"corrupt_shard", "torn_manifest", "fs_error"})
 
@@ -98,10 +121,10 @@ def _parse_event(part: str, index: int) -> FaultEvent:
     head, _, tail = part.partition(",")
     kind, sep, where = head.partition("@")
     kind = kind.strip()
-    if not sep or kind not in FAULT_KINDS:
+    if not sep or kind not in ALL_KINDS:
         raise ValueError(
             f"chaos event {part!r}: expected <fault>@<epoch>:<step>"
-            f"[:<rank>][,k=v...] with fault one of {FAULT_KINDS}")
+            f"[:<rank>][,k=v...] with fault one of {ALL_KINDS}")
     coords = where.strip().split(":")
     if len(coords) not in (2, 3):
         raise ValueError(
@@ -150,6 +173,10 @@ class ChaosPlan:
     @property
     def ckpt_events(self) -> Tuple[FaultEvent, ...]:
         return tuple(e for e in self.events if e.kind in CKPT_KINDS)
+
+    @property
+    def serve_events(self) -> Tuple[FaultEvent, ...]:
+        return tuple(e for e in self.events if e.kind in SERVE_KINDS)
 
     def describe(self) -> str:
         return "; ".join(e.describe() for e in self.events) or "<empty>"

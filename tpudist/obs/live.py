@@ -518,7 +518,9 @@ class LiveAggregator:
             sv = self._pod.setdefault("serve", {})
             for k in ("queue_depth", "active_slots", "completed",
                       "generated_tokens", "ttft_p99_s", "itl_p99_s",
-                      "tokens_per_sec_per_chip", "status"):
+                      "tokens_per_sec_per_chip", "status",
+                      "shed_total", "shed_fraction", "adapt_level",
+                      "decode_k"):
                 if rec.get(k) is not None:
                     sv[k] = rec[k]
             step = sv.get("completed")
@@ -528,6 +530,18 @@ class LiveAggregator:
             self.engine.observe("tokens_per_chip",
                                 rec.get("tokens_per_sec_per_chip"),
                                 step=step)
+            self.engine.observe("serve_shed", rec.get("shed_fraction"),
+                                step=step)
+        elif kind == "serve_adapt":
+            # the pressure controller's ladder transitions, mirrored
+            # into the live view: latest level wins the status doc and
+            # the tpudist_serve_adapt_level gauge; the full transition
+            # history stays in metrics.jsonl for the report/verifier
+            sv = self._pod.setdefault("serve", {})
+            for k in ("adapt_level", "decode_k"):
+                src = "to_level" if k == "adapt_level" else k
+                if rec.get(src) is not None:
+                    sv[k] = rec[src]
         elif kind == "goodput":
             # the run-end attempt-local goodput estimate
             # (obs.goodput.attempt_record): the same observable the
@@ -848,6 +862,13 @@ _PROM_HELP = {
     "tpudist_serve_itl_p99_seconds": "p99 inter-token latency.",
     "tpudist_serve_tokens_per_sec_per_chip": "Decode throughput per "
                                              "chip.",
+    "tpudist_serve_shed_total": "Arrivals turned away without service "
+                                "(shed at admission + expired in "
+                                "queue + rejected malformed).",
+    "tpudist_serve_shed_fraction": "Shed share of all arrivals (the "
+                                   "serve_shed gate's observable).",
+    "tpudist_serve_adapt_level": "Graceful-degradation ladder level "
+                                 "(0 = full service).",
     "tpudist_alert_firing": "1 while the named alert rule fires.",
     "tpudist_alerts_total": "Alert fire/resolve transitions so far.",
     "tpudist_records_total": "Telemetry records ingested.",
@@ -942,6 +963,11 @@ def prometheus_text(status: Dict[str, Any]) -> str:
     metric("tpudist_serve_itl_p99_seconds", [({}, sv.get("itl_p99_s"))])
     metric("tpudist_serve_tokens_per_sec_per_chip",
            [({}, sv.get("tokens_per_sec_per_chip"))])
+    metric("tpudist_serve_shed_total", [({}, sv.get("shed_total"))],
+           mtype="counter")
+    metric("tpudist_serve_shed_fraction",
+           [({}, sv.get("shed_fraction"))])
+    metric("tpudist_serve_adapt_level", [({}, sv.get("adapt_level"))])
     # one series per alert RULE: 1 when any (rule, host) key fires —
     # a fixed label set scrapers can alert on without knowing hosts
     firing_rules = {a["alert"] for a in alerts.get("firing", [])}

@@ -49,7 +49,12 @@ from tpudist.serve import slo as slo_mod
 # Schema 5: adds the "goodput" section (cross-attempt wall-clock
 # partition from the goodput ledger — tpudist.obs.goodput — or the
 # run-end kind=goodput record for single-attempt runs).
-REPORT_SCHEMA_VERSION = 5
+# Schema 6: the serving section grows the resilience plane's exact
+# shed partition (arrived/admitted/shed_at_admission/expired_in_queue/
+# rejected/lost, shed_fraction + the serve_shed gate) and the
+# degradation ladder's adapt_level/adapt_transitions; the Alerts
+# cross-check adds the serve-gate table (rules.SERVE_STATUS_RULES).
+REPORT_SCHEMA_VERSION = 6
 
 # Artifact schemas this reader KNOWS. A newer number is a warning, not
 # a failure: a requeue loop can scatter attempts across tpudist
@@ -529,6 +534,19 @@ def alerts_section(metrics: List[Dict[str, Any]],
                 warnings.append(
                     f"at-exit {status_key}=fail had NO mid-run "
                     f"{rule!r} alert — live coverage gap")
+        # the serve lane's twin of the same invariant: a kind=serve
+        # summary that graded a gate fail must have its mid-run alert
+        # (rules.SERVE_STATUS_RULES — shared with the serve drill
+        # verifier, tpudist.serve.drill)
+        serve = next((r for r in reversed(metrics)
+                      if r.get("kind") == "serve"), None)
+        if serve is not None:
+            for status_key, rule in rules_lib.SERVE_STATUS_RULES:
+                if serve.get(status_key) == FAIL \
+                        and rule not in fired_rules:
+                    warnings.append(
+                        f"at-exit serve {status_key}=fail had NO "
+                        f"mid-run {rule!r} alert — live coverage gap")
         # a watchdog stall dump in the stream means the run wedged;
         # the live stall alert must have fired before the kill
         if any(r.get("kind") == "stall_dump" for r in metrics) \
@@ -610,7 +628,8 @@ def serving_section(metrics: List[Dict[str, Any]],
         return {"enabled": False}
     s = serves[-1]
     graded = slo_mod.grade(s.get("ttft_p99_s"), s.get("itl_p99_s"),
-                           s.get("tokens_per_sec_per_chip"))
+                           s.get("tokens_per_sec_per_chip"),
+                           shed_fraction=s.get("shed_fraction"))
     ticks = [r for r in metrics if r.get("kind") == "serve_tick"]
     queue = [{"t_s": r.get("t_s"), "queue_depth": r.get("queue_depth"),
               "active_slots": r.get("active_slots"),
@@ -644,6 +663,20 @@ def serving_section(metrics: List[Dict[str, Any]],
         "decode_compiles": s.get("decode_compiles"),
         "queue_depth_max": s.get("queue_depth_max"),
         "queue_over_time": queue,
+        # the resilience plane's exact shed partition (PR 15): absent
+        # keys on pre-resilience artifacts simply read None
+        "arrived": s.get("arrived"), "admitted": s.get("admitted"),
+        "shed_at_admission": s.get("shed_at_admission"),
+        "expired_in_queue": s.get("expired_in_queue"),
+        "rejected": s.get("rejected"), "lost": s.get("lost"),
+        "shed_fraction": s.get("shed_fraction"),
+        "queue_cap": s.get("queue_cap"),
+        "ttft_deadline_s": s.get("ttft_deadline_s"),
+        "adapt_level": s.get("adapt_level"),
+        "adapt_transitions": [
+            {k: r.get(k) for k in ("t_s", "from_level", "to_level",
+                                   "decode_k", "reason")}
+            for r in metrics if r.get("kind") == "serve_adapt"],
         "tuning": ({k: tunes[-1].get(k) for k in
                     ("status", "source", "trials", "decode_k", "layout")}
                    if tunes else None),
@@ -986,6 +1019,23 @@ def to_markdown(report: Dict[str, Any]) -> str:
                   f"queue depth max {sv['queue_depth_max']}, compiles "
                   f"{sv['prefill_compiles']} prefill / "
                   f"{sv['decode_compiles']} decode", ""]
+        if sv.get("arrived") is not None:
+            lines += [f"- admission: {sv['arrived']} arrived = "
+                      f"{sv['admitted']} admitted + "
+                      f"{sv['shed_at_admission']} shed + "
+                      f"{sv['expired_in_queue']} expired + "
+                      f"{sv['rejected']} rejected "
+                      f"(shed fraction {sv['shed_fraction']}"
+                      + (f", queue cap {sv['queue_cap']}"
+                         if sv.get("queue_cap") else "")
+                      + (f", deadline {sv['ttft_deadline_s']}s"
+                         if sv.get("ttft_deadline_s") else "") + ")",
+                      ""]
+        if sv.get("adapt_transitions"):
+            lines += ["- degradation: " + "; ".join(
+                f"L{t['from_level']}→L{t['to_level']} "
+                f"(decode_k {t['decode_k']}) at {t['t_s']}s"
+                for t in sv["adapt_transitions"]), ""]
         if sv.get("tuning"):
             t = sv["tuning"]
             lines += [f"- serve tune: {t.get('status')} "

@@ -56,6 +56,14 @@ TRACE_DROP_MAX = 0.5        # verdict.trace_status (no live alert: a
 TTFT_P99_MAX = 2.0          # serve: p99 time-to-first-token (seconds)
 ITL_P99_MAX = 1.0           # serve: p99 inter-token latency (seconds)
 TOKENS_PER_CHIP_MIN = 1.0   # serve: decode throughput floor (tok/s/chip)
+# Serve admission shedding (tpudist.serve.resilience): the fraction of
+# arrivals turned away (shed at admission + expired in queue + rejected
+# garbage) — admission control keeps the ADMITTED percentiles honest
+# under overload, so the shed share itself must be gated or a pod could
+# "pass" its latency SLOs by serving almost nobody. The default tolerates
+# transient 2x bursts (~half the arrivals shed at sustained 2x) without
+# flagging; capacity-planned deployments tighten it via the env override.
+SERVE_SHED_MAX = 0.6        # serve: max shed fraction of arrivals
 
 # Goodput (tpudist.obs.goodput): productive training time as a fraction
 # of the run's total wall-clock — cross-attempt in the offline ledger,
@@ -158,6 +166,14 @@ THRESHOLDS: Tuple[Threshold, ...] = (
         description="below this floor the pod serves fewer users than "
                     "its chip count should carry"),
     Threshold(
+        name="serve_shed", env="TPUDIST_SERVE_SHED_MAX",
+        default=SERVE_SHED_MAX, sense="max", alert=True,
+        observable="fraction of arrived requests shed at admission, "
+                   "expired in queue, or rejected as malformed",
+        description="past this the admission controller is the only "
+                    "thing meeting the latency SLO — the pod is "
+                    "under-provisioned for its offered load"),
+    Threshold(
         name="goodput", env="TPUDIST_GOODPUT_MIN",
         default=GOODPUT_MIN, sense="min", alert=True,
         observable="productive training fraction of wall clock "
@@ -181,6 +197,19 @@ STATUS_RULES: Tuple[Tuple[str, str], ...] = (
     ("staging_status", "staging"),
     ("straggler_status", "straggler"),
     ("comm_status", "comm"),
+)
+
+# The serve-side twin of STATUS_RULES: the ``kind=serve`` summary's
+# per-gate status fields and the alert rule that grades the same
+# observable mid-run. ONE table shared by the report CLI's Alerts
+# cross-check and the serve drill verifier's end-to-end invariant
+# ("every SLO fail verdict had its matching mid-run alert",
+# tpudist.serve.drill) — same cannot-drift discipline as STATUS_RULES.
+SERVE_STATUS_RULES: Tuple[Tuple[str, str], ...] = (
+    ("ttft_status", "ttft"),
+    ("itl_status", "itl"),
+    ("tokens_per_chip_status", "tokens_per_chip"),
+    ("serve_shed_status", "serve_shed"),
 )
 
 _BY_NAME = {t.name: t for t in THRESHOLDS}

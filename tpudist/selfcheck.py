@@ -547,6 +547,34 @@ def check_chaos():
           f"report in {report['run_dir']})", flush=True)
 
 
+def check_serve_resilience():
+    """The serve resilience plane end to end (tpudist.serve.drill): the
+    REAL serve CLI is driven in subprocesses on a 4-device CPU mesh
+    under scripted 2x overload and the serve-surface chaos families —
+    bounded-queue shedding + deadline expiry with the arrival partition
+    checked EXACTLY, a serve_kill at a dispatch boundary classified by
+    the jax-free requeue policy and resumed with the dead attempt's
+    in-flight slots honestly counted lost, seeded malformed requests
+    rejected at admission, a per-dispatch straggler stall visible in
+    the deterministic ITL, and sustained pressure downshifting the
+    pre-compiled decode_k ladder without a recompile. The virtual
+    clock makes two same-seed runs bitwise identical, and the jax-free
+    verifier replays every invariant from the artifacts alone. Writes
+    into $TPUDIST_SERVE_DRILL_DIR when set (CI uploads it), else a
+    temp dir."""
+    from tpudist.serve import drill as serve_drill
+
+    report = serve_drill.run_and_verify()
+    bad = {name: sc["problems"]
+           for name, sc in report["scenarios"].items() if not sc["ok"]}
+    assert not bad, f"serve resilience invariants violated: {bad}"
+    assert len(report["scenarios"]) == len(serve_drill.SCENARIOS)
+    print(f"  serve resilience: {len(report['scenarios'])} scenarios "
+          f"green (shed partition exact, TTFT bounded under 2x "
+          f"overload, kill->requeue->resume honest, report in "
+          f"{report['run_dir']})", flush=True)
+
+
 def check_flight_recorder():
     """The flight-recorder pipeline end-to-end with a DELIBERATELY
     wedged step: progress beacons flow while steps advance, then the
@@ -714,6 +742,7 @@ CHECKS = [
     check_staging_stream,
     check_flight_recorder,
     check_live,
+    check_serve_resilience,
     check_train_step_smoke,
     check_moe_smoke,
 ]

@@ -17,7 +17,14 @@ with
   through the shared :mod:`tpudist.rules` table —
   :mod:`tpudist.serve.slo`;
 * a measured-probe autotuner for decode batch size and KV layout on the
-  PR-4 fingerprint-cache machinery — :mod:`tpudist.serve.tune`.
+  PR-4 fingerprint-cache machinery — :mod:`tpudist.serve.tune`;
+* the resilience plane (PR 15): admission control with deadline-based
+  load shedding and an exactly-checked arrival partition, a hysteretic
+  pressure controller over a pre-compiled decode_k ladder, and honest
+  lost-slot accounting under the launcher's requeue loop —
+  :mod:`tpudist.serve.resilience`;
+* the jax-free overload + serve fault drill and its invariant verifier
+  (``python -m tpudist.serve.drill``) — :mod:`tpudist.serve.drill`.
 
 Entry point: ``python -m tpudist.serve`` (:mod:`tpudist.serve.cli`).
 

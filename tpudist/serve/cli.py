@@ -3,10 +3,15 @@
 One command drives the whole serve stack end to end on whatever mesh
 the platform gives it (the scripted CPU mesh in CI, a pod slice under
 ``launch_tpu.sh MODE=serve``): build the model and its sharded KV
-cache, warm the two compiled programs, optionally let the serve
-autotuner pick ``decode_k``/layout by measured probe, run the
-continuous-batching loop over a seeded Poisson request stream, and
-grade the latency SLOs.
+cache, warm the compiled programs (one prefill + one decode per adapt
+rung), optionally let the serve autotuner pick ``decode_k``/layout by
+measured probe, run the continuous-batching loop — with admission
+control, deadline shedding and graceful degradation when the
+resilience knobs are on (:mod:`tpudist.serve.resilience`) — over a
+seeded Poisson request stream, and grade the latency SLOs plus the
+shed gate. Under the launcher's requeue loop (``--requeue-attempt``),
+a restarted attempt replays the still-live requests from the seeded
+schedule and classifies the dead attempt's in-flight slots as lost.
 
 Artifacts mirror the train lane's: ``metrics.jsonl`` (``kind=serve`` /
 ``serve_tick`` / ``serve_tune`` records) under ``--save-dir``, a
@@ -21,6 +26,7 @@ ungateable run (nothing measured) is not a latency regression.
 from __future__ import annotations
 
 import argparse
+import dataclasses
 import json
 import os
 import sys
@@ -72,6 +78,53 @@ def parse_args(argv: Optional[Sequence[str]] = None
                         "(<= 0: closed loop, all present at t=0)")
     p.add_argument("--max-new-tokens", type=int, default=16)
     p.add_argument("--seed", type=int, default=0)
+    # ---- the resilience plane (tpudist.serve.resilience) ----
+    p.add_argument("--queue-cap", type=int,
+                   default=_env_int("TPUDIST_SERVE_QUEUE_CAP") or 0,
+                   help="bounded admission queue: arrivals past this "
+                        "many waiting requests are SHED "
+                        "($TPUDIST_SERVE_QUEUE_CAP; 0 = unbounded)")
+    p.add_argument("--ttft-deadline-ms", type=float,
+                   default=_env_float("TPUDIST_SERVE_TTFT_DEADLINE_MS")
+                   or 0.0,
+                   help="per-request TTFT deadline: accepted requests "
+                        "still queued past this age are EXPIRED "
+                        "($TPUDIST_SERVE_TTFT_DEADLINE_MS; 0 = off)")
+    p.add_argument("--adapt", choices=("off", "on"),
+                   default=os.environ.get("TPUDIST_SERVE_ADAPT", "off"),
+                   help="graceful degradation: downshift decode_k on "
+                        "the pre-compiled ladder when rolling queue "
+                        "depth/ITL crosses the pressure thresholds, "
+                        "restore when it clears ($TPUDIST_SERVE_ADAPT)")
+    p.add_argument("--adapt-max-new-cap", type=int, default=0,
+                   help="under degradation, truncate admitted "
+                        "requests' generation budget to this many "
+                        "tokens (0 = no truncation)")
+    p.add_argument("--requeue-attempt", type=int, default=None,
+                   help="requeue loop attempt index (the launcher "
+                        "passes it whenever MAX_REQUEUES > 0): its "
+                        "PRESENCE arms supervision — per-request "
+                        "outcome events get boundary flushes so a "
+                        "preemption cannot eat them — and attempt > 0 "
+                        "replays the seeded stream MINUS requests a "
+                        "prior attempt already finished, classifying "
+                        "its in-flight slots as lost")
+    p.add_argument("--chaos", type=str,
+                   default=os.environ.get("TPUDIST_CHAOS"),
+                   help="scripted serve-surface fault plan "
+                        "(tpudist.chaos: serve_kill@0:<dispatch>, "
+                        "serve_slow, request_garbage; $TPUDIST_CHAOS)")
+    p.add_argument("--virtual-clock", action="store_true",
+                   default=os.environ.get(
+                       "TPUDIST_SERVE_VIRTUAL_CLOCK", "").lower()
+                   in ("on", "1", "true"),
+                   help="deterministic drill mode: the request clock "
+                        "advances by scripted per-prefill/per-dispatch "
+                        "costs instead of wall time — two runs of one "
+                        "seed produce bitwise-identical SLO summaries "
+                        "($TPUDIST_SERVE_VIRTUAL_CLOCK)")
+    p.add_argument("--virtual-prefill-ms", type=float, default=2.0)
+    p.add_argument("--virtual-decode-ms", type=float, default=4.0)
     p.add_argument("--serve-tune", choices=("off", "probe", "cache-only"),
                    default=os.environ.get("TPUDIST_SERVE_TUNE", "off"),
                    help="autotune decode_k/kv-layout by measured probe "
@@ -101,6 +154,45 @@ def _env_int(name: str) -> Optional[int]:
         return int(raw) if raw else None
     except ValueError:
         return None
+
+
+def _env_float(name: str) -> Optional[float]:
+    raw = os.environ.get(name)
+    try:
+        return float(raw) if raw else None
+    except ValueError:
+        return None
+
+
+def _prior_outcomes(path: str):
+    """Replay a dead attempt's flushed ``kind=serve_request`` events:
+    returns ``(accounted_rids, lost_rids)`` — rids with a terminal
+    outcome in ANY prior attempt, and admitted-to-slot rids with none
+    (the in-flight slots the kill took, which THIS attempt classifies
+    as lost rather than silently re-serving half-generated work)."""
+    import json as json_mod
+
+    from tpudist.serve import resilience as res_lib
+    admitted, terminal = set(), set()
+    try:
+        with open(path) as f:
+            for line in f:
+                try:
+                    rec = json_mod.loads(line)
+                except ValueError:
+                    continue          # a torn tail line is not evidence
+                if rec.get("kind") != "serve_request":
+                    continue
+                rid, ev = rec.get("rid"), rec.get("event")
+                if rid is None:
+                    continue
+                if ev == res_lib.ADMITTED:
+                    admitted.add(int(rid))
+                elif ev in res_lib.TERMINAL_EVENTS:
+                    terminal.add(int(rid))
+    except OSError:
+        return set(), set()
+    return terminal, admitted - terminal
 
 
 class _LoopbackEmitter:
@@ -139,20 +231,65 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
     mesh = build_mesh(ParallelConfig())
     tracer = trace_lib.configure(enabled=bool(args.trace_dir))
 
+    # --requeue-attempt's PRESENCE (any value, 0 included) means the
+    # launcher's supervision loop owns this run: outcome events must
+    # reach disk at boundaries, because a preemption may kill us and
+    # the NEXT attempt classifies from what survived
+    supervised = args.requeue_attempt is not None
+    attempt = args.requeue_attempt or 0
     os.makedirs(args.save_dir, exist_ok=True)
-    metrics = MetricsLogger(
-        path=os.path.join(args.save_dir, "metrics.jsonl"))
+    metrics_path = os.path.join(args.save_dir, "metrics.jsonl")
+    # a resumed attempt reads the DEAD attempt's flushed outcome events
+    # before this attempt appends its own
+    prior_done, prior_lost = (set(), set())
+    if attempt > 0:
+        prior_done, prior_lost = _prior_outcomes(metrics_path)
+    metrics = MetricsLogger(path=metrics_path)
     run_id = live_lib.resolve_run_id(jax.process_count())
     metrics.extra["run_id"] = run_id
+    metrics.extra["requeue_attempt"] = attempt
 
+    # the live bus: the aggregator (alert engine + alerts.jsonl +
+    # live_status.json) runs whenever live is ON — $TPUDIST_LIVE=on
+    # without a port keeps it exporter-less (the drills' mode); a port
+    # additionally serves Prometheus /metrics
+    live_on = bool(args.live_port) or os.environ.get(
+        "TPUDIST_LIVE", "").lower() in ("on", "1", "true")
     agg = server = None
-    if args.live_port:
+    if live_on:
         agg = live_lib.LiveAggregator(out_dir=args.save_dir,
                                       run_id=run_id, metrics=None,
                                       stall_timeout_s=0)
-        server = live_lib.LiveHttpServer(agg, port=args.live_port)
+        if args.live_port:
+            server = live_lib.LiveHttpServer(agg, port=args.live_port)
+            log0(f"tpudist: serve live exporter on "
+                 f":{server.port}/metrics")
         metrics.emitter = _LoopbackEmitter(agg)
-        log0(f"tpudist: serve live exporter on :{server.port}/metrics")
+
+    # the chaos plane's serve surface (tpudist.chaos, --chaos /
+    # $TPUDIST_CHAOS): serve_kill / serve_slow fire at decode-dispatch
+    # boundaries via the scheduler's hook; request_garbage folds seeded
+    # malformed requests into the arrival stream below. Off constructs
+    # nothing, same as the train CLI.
+    chaos_rt = None
+    if args.chaos:
+        from tpudist import chaos as chaos_lib
+        chaos_rt = chaos_lib.ChaosRuntime(
+            chaos_lib.ChaosPlan.parse(args.chaos),
+            process_index=jax.process_index(), metrics=metrics)
+        log0(f"tpudist: chaos on: {chaos_rt.plan.describe()}")
+
+    from tpudist.serve import resilience as res_lib
+    resilience = res_lib.ResilienceConfig(
+        queue_cap=max(args.queue_cap, 0),
+        ttft_deadline_s=max(args.ttft_deadline_ms, 0.0) / 1e3,
+        adapt=args.adapt == "on",
+        max_new_cap=max(args.adapt_max_new_cap, 0),
+        # malformed-request rejection is on whenever ANY resilience or
+        # chaos knob is: the garbage family's contract is an admission
+        # rejection, never an engine crash
+        validate=bool(args.chaos or args.queue_cap
+                      or args.ttft_deadline_ms or args.adapt == "on"))
 
     params = init_params(model_cfg, mesh, seed=args.seed)
 
@@ -174,10 +311,13 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
              f"decode_k={cand.decode_k} layout={cand.layout} "
              f"[{out.trials} trial(s)]")
 
+    ladder = (res_lib.default_ladder(cand.decode_k)
+              if resilience.adapt else None)
     engine = ServeEngine(model_cfg, mesh, slots=args.slots,
                          max_seq=args.max_seq,
                          prompt_pad=args.prompt_pad,
-                         decode_k=cand.decode_k, layout=cand.layout)
+                         decode_k=cand.decode_k, layout=cand.layout,
+                         adapt_ladder=ladder)
     with trace_lib.span("serve_warmup", cat="serve"):
         engine.warmup(params)
 
@@ -185,11 +325,66 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
         args.requests, prompt_pad=args.prompt_pad,
         vocab_size=args.vocab_size, max_new=args.max_new_tokens,
         rate=args.request_rate, seed=args.seed)
-    summary = sched.run_serve(engine, params, requests, metrics=metrics)
+    if chaos_rt is not None:
+        # request_garbage: the fault IS the malformed requests — fold
+        # them into the (deterministic) schedule; admission rejects
+        span = max((r.arrival_s for r in requests), default=0.0)
+        rid_base = len(requests)
+        for ev in chaos_rt.consume_request_garbage():
+            garbage = sched.make_garbage_requests(
+                chaos_rt.plan, ev, rid_base=rid_base,
+                prompt_pad=args.prompt_pad, vocab_size=args.vocab_size,
+                span_s=span)
+            requests.extend(garbage)
+            rid_base += len(garbage)
+
+    n_lost = 0
+    if attempt > 0:
+        # honest supervision accounting: a prior attempt's in-flight
+        # slots are LOST (their KV state died with the engine — a
+        # half-generated answer is not resumable), its queued/unserved
+        # requests are replayed from the deterministic schedule
+        for rid in sorted(prior_lost):
+            metrics.log(kind="serve_request", rid=rid, event="lost")
+            n_lost += 1
+        remaining = [r for r in requests
+                     if r.rid not in prior_done
+                     and r.rid not in prior_lost]
+        shift = min((r.arrival_s for r in remaining), default=0.0)
+        requests = [dataclasses.replace(r, arrival_s=r.arrival_s - shift)
+                    for r in remaining]
+        metrics.log(kind="serve_resume",
+                    completed_prior=len(prior_done), lost=n_lost,
+                    replayed=len(requests))
+        metrics.flush()
+        log0(f"tpudist: serve resume (attempt {attempt}): "
+             f"{len(prior_done)} done in prior attempt(s), {n_lost} "
+             f"in-flight lost, replaying {len(requests)}")
+
+    virtual = None
+    if args.virtual_clock:
+        virtual = res_lib.VirtualTiming(
+            prefill_s=args.virtual_prefill_ms / 1e3,
+            decode_s=args.virtual_decode_ms / 1e3)
+    summary = sched.run_serve(engine, params, requests, metrics=metrics,
+                              resilience=resilience, chaos=chaos_rt,
+                              virtual=virtual,
+                              flush_events=True if supervised else None)
     engine.assert_two_programs()
 
     summary["run_id"] = run_id
     summary["model"] = args.model
+    summary["requeue_attempt"] = attempt
+    if attempt > 0:
+        # the summary-level ``lost`` is everything THIS attempt knows
+        # was lost: in-process losses are impossible (a kill that takes
+        # slots never writes a summary), so the resumed attempt's
+        # classification of the dead attempt's in-flight slots IS the
+        # number — lifted here so the report/bench lanes surface it
+        # (the ``partition`` block stays the attempt-local checked
+        # ledger, where lost is 0 by construction)
+        summary["lost"] = n_lost
+        summary["completed_prior"] = len(prior_done)
     cache_bytes = engine.spec.bytes
     summary["kv_cache_bytes"] = cache_bytes
     metrics.log(kind="serve",
@@ -242,7 +437,11 @@ def _write_bench(path: str, args: argparse.Namespace,
             "tokens_per_sec", "queue_depth_max", "queue_depth_mean",
             "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
             "e2e_p50_s", "e2e_p99_s", "prefill_compiles",
-            "decode_compiles", "n_chips")},
+            "decode_compiles", "n_chips",
+            "arrived", "admitted", "shed_at_admission",
+            "expired_in_queue", "rejected", "lost", "completed_prior",
+            "shed_fraction", "queue_cap", "ttft_deadline_s",
+            "adapt_level", "decode_k_ladder", "requeue_attempt")},
         "slo": slo_lib.slo_block(summary),
         "device": jax.devices()[0].device_kind,
     }

@@ -22,6 +22,11 @@ the CI lane):
   superstep bitwise) — so one compiled program serves every batch
   occupancy from full to empty.
 
+With graceful degradation on (``adapt_ladder``), the contract
+generalises to one decode program PER LADDER RUNG, all compiled at
+warmup: a pressure downshift switches programs, it never traces one.
+
+
 Greedy decoding is a pure function of (params, state), so runs are
 bitwise reproducible; decode-with-cache logits are pinned ULP-close to
 the full forward (tests/test_serve.py).
@@ -29,7 +34,7 @@ the full forward (tests/test_serve.py).
 
 from __future__ import annotations
 
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -71,11 +76,20 @@ class ServeEngine:
     ``prompt_pad`` is the static prompt width every admission pads to;
     ``decode_k`` the superstep length (tokens per dispatch per slot);
     ``layout`` the KV storage layout (:mod:`tpudist.serve.kvcache`).
+
+    ``adapt_ladder`` is the graceful-degradation rung set
+    (:func:`tpudist.serve.resilience.default_ladder`): ONE decode
+    program is compiled per distinct ``k`` at warmup, so the pressure
+    controller downshifting mid-run switches to an already-compiled
+    program — the latency SLO never pays a recompile for degrading.
+    The default ladder is ``(decode_k,)``, which keeps the original
+    two-program contract bit-for-bit.
     """
 
     def __init__(self, model_cfg: ModelConfig, mesh, *, slots: int,
                  max_seq: int, prompt_pad: int, decode_k: int = 8,
-                 layout: str = "st", dtype=jnp.float32):
+                 layout: str = "st", dtype=jnp.float32,
+                 adapt_ladder: Optional[Sequence[int]] = None):
         if slots < 1:
             raise ValueError(f"--slots must be >= 1, got {slots}")
         if decode_k < 1:
@@ -90,6 +104,17 @@ class ServeEngine:
         self.mesh = mesh
         self.slots, self.max_seq = int(slots), int(max_seq)
         self.prompt_pad, self.decode_k = int(prompt_pad), int(decode_k)
+        ladder = tuple(int(k) for k in (adapt_ladder or (decode_k,)))
+        if not ladder or ladder[0] != self.decode_k:
+            raise ValueError(
+                f"adapt_ladder {ladder} must start at decode_k "
+                f"{self.decode_k} (level 0 = full service)")
+        if any(k < 1 for k in ladder) \
+                or any(a <= b for a, b in zip(ladder, ladder[1:])):
+            raise ValueError(
+                f"adapt_ladder {ladder} must be strictly descending "
+                f"positive superstep lengths")
+        self.ladder = ladder
         self.layout, self.dtype = layout, dtype
         self.spec = kvcache.CacheSpec.from_model(
             model_cfg, slots=slots, max_seq=max_seq, dtype=dtype,
@@ -97,7 +122,10 @@ class ServeEngine:
         self.prefill_traces: list = []
         self.decode_traces: list = []
         self._prefill = jax.jit(self._prefill_body, donate_argnums=(1,))
-        self._decode = jax.jit(self._decode_body, donate_argnums=(1,))
+        # k is STATIC (it is the lax.scan length): one compiled decode
+        # program per ladder rung, all traced at warmup
+        self._decode = jax.jit(self._decode_body, static_argnums=(2,),
+                               donate_argnums=(1,))
 
     # ----------------------------------------------------------- state
 
@@ -168,9 +196,9 @@ class ServeEngine:
 
     # ---------------------------------------------------------- decode
 
-    def _decode_body(self, params, state: ServeState
+    def _decode_body(self, params, state: ServeState, k: int
                      ) -> Tuple[ServeState, jax.Array, jax.Array]:
-        self.decode_traces.append(1)    # trace-time compile marker
+        self.decode_traces.append(k)    # trace-time compile marker
         slots = self.slots
 
         def step(st: ServeState, _):
@@ -214,41 +242,60 @@ class ServeEngine:
             st, tok, valid = lax.cond(st.active.any(), run, skip, st)
             return st, (tok, valid)
 
-        state, (toks, valid) = lax.scan(step, state, None,
-                                        length=self.decode_k)
+        state, (toks, valid) = lax.scan(step, state, None, length=k)
         return state, toks, valid
 
-    def decode(self, params, state: ServeState
+    def decode(self, params, state: ServeState, k: Optional[int] = None
                ) -> Tuple[ServeState, jax.Array, jax.Array]:
-        """One decode superstep: up to ``decode_k`` tokens for every
-        active slot. Returns ``(state, tokens (k, slots), valid (k,
-        slots))`` — entries with ``valid=False`` are placeholders (-1)
-        and must not be read. Async: fence on the returned tokens."""
-        return self._decode(params, state)
+        """One decode superstep: up to ``k`` (default ``decode_k``)
+        tokens for every active slot. ``k`` must be a warmed ladder
+        rung — any other value would trace a new program mid-run and
+        break the program-budget pin. Returns ``(state, tokens (k,
+        slots), valid (k, slots))`` — entries with ``valid=False`` are
+        placeholders (-1) and must not be read. Async: fence on the
+        returned tokens."""
+        k = self.decode_k if k is None else int(k)
+        if k not in self.ladder:
+            # fail at the fault site: a foreign k would silently trace
+            # a NEW program mid-run — charging XLA compilation to
+            # exactly the latency a downshift is trying to relieve —
+            # and only surface at the end-of-run program pin, if ever
+            raise ValueError(
+                f"decode k={k} is not a warmed ladder rung "
+                f"{self.ladder}")
+        return self._decode(params, state, k)
 
     # ---------------------------------------------------------- warmup
 
     def warmup(self, params) -> None:
-        """Compile both programs OFF the request clock: a cold first
-        admission would charge XLA compilation to that request's TTFT.
-        Runs a dummy prefill + one decode superstep on a throwaway
-        state (donated away), fences, and leaves both jit caches warm —
-        after this, a whole serve run compiles nothing
-        (``assert_two_programs``)."""
+        """Compile every program OFF the request clock: a cold first
+        admission would charge XLA compilation to that request's TTFT,
+        and a cold ladder rung would charge a recompile to the very
+        overload the downshift is trying to relieve. Runs a dummy
+        prefill + one decode superstep PER LADDER RUNG on a throwaway
+        state (donated away), fences, and leaves the jit caches warm —
+        after this, a whole serve run (adapt transitions included)
+        compiles nothing (``assert_two_programs``)."""
         state = self.init_state()
         dummy = jnp.zeros((1, self.prompt_pad), jnp.int32)
         state, first = self.prefill(params, state, dummy, 1, 0, 2)
-        state, toks, valid = self.decode(params, state)
-        jax.device_get((first, toks, valid))
+        jax.device_get(first)
+        for k in self.ladder:
+            state, toks, valid = self.decode(params, state, k)
+            jax.device_get((toks, valid))
 
     def compile_counts(self) -> Tuple[int, int]:
         return len(self.prefill_traces), len(self.decode_traces)
 
     def assert_two_programs(self) -> None:
-        """The compiled-program pin: one prefill + one decode trace for
-        the whole run, warmup included."""
+        """The compiled-program pin: one prefill + one decode trace PER
+        LADDER RUNG for the whole run, warmup included — exactly two
+        programs on the default single-rung ladder, and never a trace
+        the warmup didn't already pay."""
         p, d = self.compile_counts()
-        if (p, d) != (1, 1):
+        want = (1, len(self.ladder))
+        if (p, d) != want:
             raise AssertionError(
                 f"serve engine compiled {p} prefill / {d} decode "
-                f"program(s); the two-program contract is broken")
+                f"program(s), expected {want[0]}/{want[1]} for ladder "
+                f"{self.ladder}; the two-program contract is broken")

@@ -3,11 +3,25 @@
 The host half of the serving engine. Requests arrive on an open-loop
 Poisson schedule (a synthetic stand-in for "millions of users" — rate,
 prompt lengths and generation budgets are all seeded, so a serve run is
-reproducible end to end), queue until a slot frees, prefill into the
-free slot, and decode continuously: every dispatch is one compiled
-superstep over the WHOLE slot batch, with completed slots freed and
-refilled between dispatches — no draining, no batch reshaping, no
-recompiles.
+reproducible end to end), pass ADMISSION CONTROL (bounded queue,
+per-request TTFT deadlines, malformed-request rejection —
+:mod:`tpudist.serve.resilience`), queue until a slot frees, prefill
+into the free slot, and decode continuously: every dispatch is one
+compiled superstep over the WHOLE slot batch, with completed slots
+freed and refilled between dispatches — no draining, no batch
+reshaping, no recompiles.
+
+Under overload the queue does NOT grow unboundedly: arrivals past
+``queue_cap`` are shed at admission, and accepted requests that age
+past ``ttft_deadline_s`` while still queued are expired before they
+ever touch a slot — so the requests the pod DOES serve keep a bounded
+TTFT instead of every percentile inheriting the backlog. Every arrival
+lands in exactly one ledger bucket (``arrived == admitted +
+shed_at_admission + expired_in_queue + rejected``, checked exactly),
+and every shed/expiry decision reads ONE monotonic clock sample per
+scheduler boundary — no wall-clock reads inside the decision path, so
+the seeded schedule sheds the same requests every run (bitwise, under
+the drill's virtual clock).
 
 Latency accounting happens here because only the host sees the request
 clock: TTFT spans arrival → the fenced prefill that produced the first
@@ -30,6 +44,7 @@ import numpy as np
 from tpudist import rules as rules_lib
 from tpudist.obs import trace as trace_lib
 from tpudist.obs.alerts import AlertEngine
+from tpudist.serve import resilience as res_lib
 from tpudist.serve import slo as slo_lib
 from tpudist.serve.engine import ServeEngine
 
@@ -78,42 +93,153 @@ def make_requests(n: int, *, prompt_pad: int, vocab_size: int,
     return out
 
 
+def validate_request(req: Request, *, prompt_pad: int,
+                     vocab_size: int) -> Optional[str]:
+    """Admission-time request validation: the reason a malformed
+    request is rejected, or None for a well-formed one. The engine's
+    compiled prefill assumes a (prompt_pad,) int32 prompt with an
+    in-range true length and a positive budget — anything else must be
+    turned away HERE (the ``request_garbage`` chaos family's contract:
+    garbage costs itself a rejection, never the engine)."""
+    pl, mn = req.prompt_len, req.max_new
+    if not isinstance(pl, (int, np.integer)) or not (0 < pl <= prompt_pad):
+        return "bad_prompt_len"
+    if not isinstance(mn, (int, np.integer)) or mn < 1:
+        return "bad_max_new"
+    try:
+        toks = np.asarray(req.tokens)
+    except Exception:
+        return "bad_tokens"
+    if toks.shape != (prompt_pad,):
+        return "bad_shape"
+    if not np.issubdtype(toks.dtype, np.integer):
+        return "bad_dtype"
+    if ((toks[:pl] < 0) | (toks[:pl] >= vocab_size)).any():
+        return "bad_token"
+    return None
+
+
+def make_garbage_requests(plan, event, *, rid_base: int, prompt_pad: int,
+                          vocab_size: int, span_s: float
+                          ) -> List[Request]:
+    """The ``request_garbage`` chaos family's payload: ``n`` seeded
+    malformed requests spread over the arrival window, each broken a
+    deterministically-chosen way (out-of-range tokens, zero/oversized
+    prompt_len, dead budget, wrong shape, float tokens). Derived from
+    the plan's keyed byte stream, so the same spec injects the same
+    garbage every run and the fuzz drill is replayable."""
+    from tpudist.chaos import plan as plan_mod
+    n = int(event.args.get("n", 4))
+    raw = plan_mod.garbage_bytes(plan, event, n=8 * max(n, 1))
+    modes = ("bad_token", "zero_len", "over_len", "bad_max_new",
+             "bad_shape", "bad_dtype")
+    out: List[Request] = []
+    for i in range(n):
+        chunk = raw[8 * i:8 * i + 8]
+        arrival = (int.from_bytes(chunk[:4], "big") / 0xFFFFFFFF) \
+            * max(span_s, 0.0)
+        mode = modes[chunk[4] % len(modes)]
+        tokens = np.zeros((prompt_pad,), np.int32)
+        prompt_len, max_new = max(1, prompt_pad // 2), 4
+        if mode == "bad_token":
+            tokens[0] = vocab_size + 1 + chunk[5]
+        elif mode == "zero_len":
+            prompt_len = 0
+        elif mode == "over_len":
+            prompt_len = prompt_pad + 1 + chunk[5] % 8
+        elif mode == "bad_max_new":
+            max_new = -int(chunk[5])
+        elif mode == "bad_shape":
+            tokens = np.zeros((prompt_pad + 3,), np.int32)
+        elif mode == "bad_dtype":
+            tokens = np.zeros((prompt_pad,), np.float64) + 0.5
+        out.append(Request(rid=rid_base + i, arrival_s=float(arrival),
+                           tokens=tokens, prompt_len=prompt_len,
+                           max_new=max_new))
+    return out
+
+
 @dataclasses.dataclass
 class _Slot:
     req: Request
     generated: int
     first_token_s: float
     output: List[int]
+    budget: int               # max_new after any adapt-time truncation
 
 
 def run_serve(engine: ServeEngine, params, requests: List[Request], *,
               metrics: Any = None, tick_every: int = 8,
               clock: Callable[[], float] = time.perf_counter,
-              n_chips: Optional[int] = None) -> Dict[str, Any]:
+              n_chips: Optional[int] = None,
+              resilience: Optional[res_lib.ResilienceConfig] = None,
+              chaos: Any = None,
+              virtual: Optional[res_lib.VirtualTiming] = None,
+              flush_events: Optional[bool] = None) -> Dict[str, Any]:
     """Drive the engine over the request stream; returns the run summary
-    (percentiles, throughput, per-gate SLO statuses, compile counts).
+    (percentiles, throughput, per-gate SLO statuses, the exact shed
+    partition, compile counts).
 
     The engine must already be warmed (:meth:`ServeEngine.warmup`) so
     the request clock never pays XLA compilation. ``metrics`` (a
-    MetricsLogger) receives periodic ``kind=serve_tick`` records; the
-    caller logs the final ``kind=serve`` summary so it can stamp its own
-    fields in."""
+    MetricsLogger) receives periodic ``kind=serve_tick`` records plus
+    per-request ``kind=serve_request`` outcome events; the caller logs
+    the final ``kind=serve`` summary so it can stamp its own fields in.
+
+    ``resilience`` turns on admission control / degradation
+    (:class:`~tpudist.serve.resilience.ResilienceConfig`; None keeps
+    the pre-resilience open-loop behavior bit-for-bit). ``chaos`` is a
+    :class:`~tpudist.chaos.inject.ChaosRuntime` whose serve surface
+    (``on_serve_dispatch``) fires at every decode-dispatch boundary.
+    ``virtual`` switches the request clock to deterministic virtual
+    time (:class:`~tpudist.serve.resilience.VirtualTiming`) — the
+    overload drill's bitwise mode. ``flush_events`` arms BOUNDARY
+    flushes of the buffered per-request outcome events — before every
+    chaos dispatch hook and on the tick cadence — so a kill cannot eat
+    the evidence the resumed attempt classifies from (default: on when
+    chaos or resilience is armed; the CLI also arms it under the
+    launcher's requeue supervision)."""
     import jax
     if n_chips is None:
         n_chips = max(jax.device_count(), 1)
+    res = resilience or res_lib.ResilienceConfig()
+    if virtual is not None:
+        clock = virtual.clock
+    if flush_events is None:
+        flush_events = chaos is not None or res.enabled
     tracer = trace_lib.get()
     stats = slo_lib.LatencyStats()
     alerts = AlertEngine()
-    queue = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+    led = res_lib.ShedLedger()
+    controller = None
+    if res.adapt and len(engine.ladder) > 1:
+        controller = res_lib.PressureController(
+            res, max_level=len(engine.ladder) - 1)
+    cur_level = 0
+    cur_k = engine.ladder[0]
+    pending = deque(sorted(requests, key=lambda r: (r.arrival_s, r.rid)))
+    waiting: deque = deque()         # accepted, not yet slotted
     slots: List[Optional[_Slot]] = [None] * engine.slots
     state = engine.init_state()
     results: Dict[int, Dict[str, Any]] = {}
     generated = truncated = dispatches = 0
     queue_depths: List[int] = []
+    recent_tok: deque = deque(maxlen=max(res.window, 1))
     t0 = clock()
 
     def now() -> float:
         return clock() - t0
+
+    def event(rid: int, ev: str, **kw: Any) -> None:
+        # the per-request outcome stream the drill verifier (and a
+        # resumed attempt's lost-slot classification) replays. Buffered
+        # here — durability comes from the BOUNDARY flushes below (per
+        # dispatch ahead of the chaos hook, per tick otherwise), not a
+        # write+flush per outcome on the serving host path
+        if metrics is None:
+            return
+        metrics.log(kind="serve_request", rid=rid, event=ev,
+                    t_s=round(now(), 6), **kw)
 
     def finish(i: int, why: str) -> None:
         nonlocal truncated
@@ -121,83 +247,156 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         results[s.req.rid] = {
             "tokens": list(s.output), "prompt_len": s.req.prompt_len,
             "generated": s.generated, "why": why,
+            "adapt_truncated": s.budget < s.req.max_new,
             "e2e_s": now() - s.req.arrival_s}
         stats.note_e2e(now() - s.req.arrival_s)
         if why == "evicted":
             truncated += 1
+            led.evicted += 1
+        else:
+            led.completed += 1
+        event(s.req.rid, res_lib.DONE if why == "done" else
+              res_lib.EVICTED, generated=s.generated)
         slots[i] = None
+
+    def expire(t: float) -> None:
+        # the accepted queue's head is always the oldest (FIFO in
+        # arrival order), so deadline expiry only ever pops from there
+        while waiting and t - waiting[0].arrival_s \
+                > res.ttft_deadline_s:
+            r = waiting.popleft()
+            led.expired_queue += 1
+            event(r.rid, res_lib.EXPIRED,
+                  waited_s=round(t - r.arrival_s, 6))
+
+    def pump(t: float) -> None:
+        """Admission control at ONE sampled time ``t``: first expire
+        the deadline-aged queue heads, THEN process arrivals against
+        the post-expiry queue — a fresh arrival must never be shed at
+        the cap by requests that are already dead at the same sampled
+        instant. An arrival whose own deadline passed while it sat in
+        the schedule backlog counts expired, not shed (it was never
+        servable). No clock reads in here: determinism under the
+        seeded schedule is exactly this function never asking twice."""
+        if res.ttft_deadline_s > 0:
+            expire(t)
+        while pending and pending[0].arrival_s <= t:
+            req = pending.popleft()
+            led.arrived += 1
+            why = validate_request(
+                req, prompt_pad=engine.prompt_pad,
+                vocab_size=engine.model_cfg.vocab_size) \
+                if res.validate else None
+            if why is not None:
+                led.rejected += 1
+                event(req.rid, res_lib.REJECTED, reason=why)
+            elif res.ttft_deadline_s > 0 \
+                    and t - req.arrival_s > res.ttft_deadline_s:
+                led.expired_queue += 1
+                event(req.rid, res_lib.EXPIRED,
+                      waited_s=round(t - req.arrival_s, 6))
+            elif res.queue_cap and len(waiting) >= res.queue_cap:
+                led.shed_admission += 1
+                event(req.rid, res_lib.SHED,
+                      queue_depth=len(waiting))
+            else:
+                waiting.append(req)
 
     def admit() -> None:
         nonlocal generated, state
         t = now()
+        pump(t)
         for i in range(engine.slots):
-            if slots[i] is not None or not queue \
-                    or queue[0].arrival_s > t:
+            if slots[i] is not None or not waiting:
                 continue
-            req = queue.popleft()
+            req = waiting.popleft()
+            budget = req.max_new
+            if cur_level > 0 and res.max_new_cap:
+                budget = min(budget, res.max_new_cap)
             with tracer.span("admit", cat="serve", rid=req.rid, slot=i):
                 pass   # the admission decision itself is host-trivial
             with tracer.span("prefill", cat="serve", rid=req.rid,
                              slot=i, prompt_len=req.prompt_len):
                 state, first = engine.prefill(
                     params, state, req.tokens[None, :], req.prompt_len,
-                    i, req.max_new)
+                    i, budget)
                 first = int(first)           # fence: the token exists NOW
+            if virtual is not None:
+                virtual.clock.advance(virtual.prefill_s)
             t_first = now()
+            led.admitted += 1
+            event(req.rid, res_lib.ADMITTED, slot=i,
+                  waited_s=round(t_first - req.arrival_s, 6))
             stats.note_ttft(t_first - req.arrival_s)
             generated += 1
             slots[i] = _Slot(req=req, generated=1, first_token_s=t_first,
-                             output=[first])
-            if req.max_new <= 1 or req.prompt_len >= engine.max_seq:
-                finish(i, "done" if req.max_new <= 1 else "evicted")
+                             output=[first], budget=budget)
+            if budget <= 1 or req.prompt_len >= engine.max_seq:
+                finish(i, "done" if budget <= 1 else "evicted")
             t = now()
-
-    def arrived_depth() -> int:
-        # ONLY requests whose arrival time has passed: the deque holds
-        # the whole future synthetic schedule, and "queued" must mean
-        # waiting-for-a-slot, not not-yet-generated (the Prometheus
-        # gauge and the report's queue_over_time both promise that)
-        t = now()
-        n = 0
-        for r in queue:            # arrival-sorted: break at the future
-            if r.arrival_s > t:
-                break
-            n += 1
-        return n
+            pump(t)        # arrivals that landed during the prefill
 
     def observe_slos(summ: Dict[str, Any]) -> None:
         alerts.observe("ttft", summ["ttft_p99_s"])
         alerts.observe("itl", summ["itl_p99_s"])
+        alerts.observe("serve_shed", led.shed_fraction())
         wall = now()
         if wall > 0 and generated:
             alerts.observe("tokens_per_chip",
                            generated / wall / n_chips)
 
-    while len(results) < len(requests):
+    while len(results) + led.shed_total() < len(requests):
         admit()
         occupied = [i for i in range(engine.slots) if slots[i] is not None]
         if not occupied:
-            # nothing running and nothing arrived yet: wait out the gap
-            # to the next scheduled arrival (bounded — the generator's
+            if waiting:
+                # accepted work and free slots, but every slot FINISHED
+                # inside this admit pass (an instant budget<=1 / full-
+                # prompt completion): loop straight back into admit.
+                # This check must come BEFORE the next-arrival wait —
+                # warping the clock past queued servable requests would
+                # expire (or TTFT-inflate) them with slots sitting free
+                continue
+            # nothing running and nothing queued: wait out the gap to
+            # the next scheduled arrival (bounded — the generator's
             # schedule is finite)
-            if queue:
-                time.sleep(min(0.002, max(0.0,
-                                          queue[0].arrival_s - now())))
+            if pending:
+                if virtual is not None:
+                    virtual.clock.wait_until(t0 + pending[0].arrival_s)
+                else:
+                    time.sleep(min(0.002, max(
+                        0.0, pending[0].arrival_s - now())))
                 continue
             break
         # depth sampled once per DISPATCH (not per idle busy-wait pass:
         # a sparse schedule would drown the mean in idle-gap zeros and
         # grow the sample list unboundedly)
-        queue_depths.append(arrived_depth())
+        queue_depths.append(len(waiting))
+        # the chaos serve surface: serve_kill dies HERE (a dispatch
+        # boundary — the compiled program is never torn mid-flight),
+        # serve_slow returns the stall it injected so virtual time can
+        # account it. Flush the buffered outcome events FIRST: a kill
+        # at this boundary must not eat the evidence the resumed
+        # attempt's lost-slot classification replays.
+        stall_s = 0.0
+        if chaos is not None:
+            if flush_events and metrics is not None:
+                metrics.flush()
+            stall_s = float(chaos.on_serve_dispatch(dispatches) or 0.0)
         t_dispatch = clock()
         with tracer.span("decode_step", cat="serve",
-                         active=len(occupied)):
-            state, toks, valid = engine.decode(params, state)
+                         active=len(occupied), decode_k=cur_k):
+            state, toks, valid = engine.decode(params, state, cur_k)
             toks = np.asarray(toks)          # fence: tokens on host
             valid = np.asarray(valid)
-        dt = clock() - t_dispatch
+        if virtual is not None:
+            dt = virtual.decode_s + stall_s
+            virtual.clock.advance(dt)
+        else:
+            dt = (clock() - t_dispatch) + stall_s
         dispatches += 1
-        per_tok = dt / engine.decode_k
+        per_tok = dt / cur_k
+        recent_tok.append(per_tok)
         for i in occupied:
             col_valid = valid[:, i]
             n_new = int(col_valid.sum())
@@ -208,7 +407,7 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                 generated += n_new
                 stats.note_itl(per_tok, n_new)
             s = slots[i]
-            if s.generated >= s.req.max_new:
+            if s.generated >= s.budget:
                 finish(i, "done")
             elif s.req.prompt_len + s.generated > engine.max_seq:
                 # aligned with the DEVICE freeze (lengths >= max_seq,
@@ -222,15 +421,41 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         # in the inter-dispatch gap — inflating the very ITL it grades
         if dispatches % max(tick_every, 1) != 0:
             continue
+        if flush_events and metrics is not None:
+            # amortised durability for the supervised-but-unchaosed
+            # path (a REAL preemption can land anywhere): at most one
+            # tick window of outcome events is at risk, not the run
+            metrics.flush()
         summ = stats.summary()
         observe_slos(summ)
+        if controller is not None:
+            recent_itl = (sum(recent_tok) / len(recent_tok)
+                          if recent_tok else None)
+            trans = controller.observe(len(waiting), recent_itl)
+            if trans is not None:
+                frm, to, reason = trans
+                cur_level = to
+                cur_k = engine.ladder[min(to, len(engine.ladder) - 1)]
+                if metrics is not None:
+                    # every ladder move is a flushed, auditable record:
+                    # the drill verifier and the live view both read it
+                    metrics.log(kind="serve_adapt",
+                                t_s=round(now(), 4), from_level=frm,
+                                to_level=to, decode_k=cur_k,
+                                queue_depth=len(waiting),
+                                reason=reason)
+                    metrics.flush()
         if metrics is not None:
             wall = now()
             metrics.log(kind="serve_tick", t_s=round(wall, 4),
-                        queue_depth=arrived_depth(),
+                        queue_depth=len(waiting),
                         active_slots=sum(s is not None for s in slots),
                         completed=len(results),
                         generated_tokens=generated,
+                        shed_total=led.shed_total(),
+                        shed_fraction=led.shed_fraction(),
+                        adapt_level=cur_level,
+                        decode_k=cur_k,
                         ttft_p99_s=summ["ttft_p99_s"],
                         itl_p99_s=summ["itl_p99_s"],
                         tokens_per_sec_per_chip=(
@@ -247,7 +472,7 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
     if requests:
         observe_slos(summ)   # runs shorter than a tick still fire
     grade = slo_lib.grade(summ["ttft_p99_s"], summ["itl_p99_s"],
-                          tps_chip)
+                          tps_chip, shed_fraction=led.shed_fraction())
     return {
         "requests": len(requests), "completed": len(results),
         "generated_tokens": generated, "truncated": truncated,
@@ -261,6 +486,22 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         "queue_depth_max": max(queue_depths, default=0),
         "queue_depth_mean": (round(float(np.mean(queue_depths)), 3)
                              if queue_depths else 0.0),
+        # the exact shed partition (headline fields lifted for the
+        # bench/report consumers; the full checked block under
+        # "partition")
+        "arrived": led.arrived, "admitted": led.admitted,
+        "shed_at_admission": led.shed_admission,
+        "expired_in_queue": led.expired_queue,
+        "rejected": led.rejected, "lost": led.lost,
+        "shed_total": led.shed_total(),
+        "shed_fraction": led.shed_fraction(),
+        "partition": led.as_dict(),
+        "queue_cap": res.queue_cap,
+        "ttft_deadline_s": res.ttft_deadline_s,
+        "adapt_level": cur_level, "decode_k_current": cur_k,
+        "decode_k_ladder": list(engine.ladder),
+        "adapt_transitions": (list(controller.transitions)
+                              if controller is not None else []),
         **{k: (round(v, 6) if v is not None else None)
            for k, v in summ.items()},
         **grade,

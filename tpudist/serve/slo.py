@@ -18,6 +18,10 @@ The three serving observables:
   granularity (``k=1`` recovers true per-token timing).
 * **tokens/s/chip** — generated tokens (first tokens included) over the
   serving wall clock, per chip.
+* **shed fraction** — the resilience plane's admission gate (PR 15):
+  (shed + expired + rejected) / arrived, graded against
+  ``TPUDIST_SERVE_SHED_MAX`` — admitted-traffic latency stays honest
+  only because overload is shed, so the shed share is itself gated.
 """
 
 from __future__ import annotations
@@ -33,9 +37,14 @@ FAIL = "fail"            # the import (same pattern as obs.alerts)
 UNGATEABLE = "ungateable"
 
 # The serve gates, in grading order; each is (rule name, summary key).
+# serve_shed (the resilience plane's admission gate) grades the shed
+# share of all arrivals: a pod turning away more than the ceiling is
+# under-provisioned even when every ADMITTED request met its latency
+# SLO — bounded TTFT bought by unbounded shedding is not a pass.
 SERVE_RULES = (("ttft", "ttft_p99_s"),
                ("itl", "itl_p99_s"),
-               ("tokens_per_chip", "tokens_per_sec_per_chip"))
+               ("tokens_per_chip", "tokens_per_sec_per_chip"),
+               ("serve_shed", "shed_fraction"))
 
 
 def percentile(xs: List[float], q: float) -> Optional[float]:
@@ -88,12 +97,16 @@ def rule_status(rule: str, value: Optional[float]) -> str:
 
 
 def grade(ttft_p99_s: Optional[float], itl_p99_s: Optional[float],
-          tokens_per_sec_per_chip: Optional[float]) -> Dict[str, str]:
-    """All three serve gates + the fold: overall ``status`` is FAIL if
+          tokens_per_sec_per_chip: Optional[float],
+          shed_fraction: Optional[float] = None) -> Dict[str, str]:
+    """All four serve gates + the fold: overall ``status`` is FAIL if
     any gate fails, UNGATEABLE if nothing was measurable, else
-    SUCCESS."""
+    SUCCESS. ``shed_fraction`` is None on pre-resilience artifacts (and
+    empty runs) — the serve_shed gate reads UNGATEABLE there, never a
+    retroactive fail."""
     vals = {"ttft_p99_s": ttft_p99_s, "itl_p99_s": itl_p99_s,
-            "tokens_per_sec_per_chip": tokens_per_sec_per_chip}
+            "tokens_per_sec_per_chip": tokens_per_sec_per_chip,
+            "shed_fraction": shed_fraction}
     out = {f"{rule}_status": rule_status(rule, vals[key])
            for rule, key in SERVE_RULES}
     statuses = list(out.values())

@@ -214,6 +214,10 @@ def goodput_status(fraction, min_fraction: float | None = None) -> str:
 TTFT_P99_MAX = rules_lib.TTFT_P99_MAX
 ITL_P99_MAX = rules_lib.ITL_P99_MAX
 TOKENS_PER_CHIP_MIN = rules_lib.TOKENS_PER_CHIP_MIN
+# Serve admission-shed ceiling (tpudist.serve.resilience): graded as a
+# fourth serve gate through serve.slo.grade — env override
+# TPUDIST_SERVE_SHED_MAX, read at call time like every other gate.
+SERVE_SHED_MAX = rules_lib.SERVE_SHED_MAX
 
 
 def serve_status(ttft_p99_s, itl_p99_s, tokens_per_sec_per_chip) -> str:
