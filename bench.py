@@ -564,7 +564,8 @@ def run_serve_sweep(out_path: str, requests: int = 32,
     from tpudist.serve import scheduler as sched
     from tpudist.serve import slo as slo_lib
     from tpudist.serve import tune as serve_tune
-    from tpudist.serve.engine import ServeEngine, init_params
+    from tpudist.serve.engine import (PagedServeEngine, ServeEngine,
+                                      init_params)
 
     model_cfg = ModelConfig(name="transformer", vocab_size=256,
                             n_layers=2, d_model=64, n_heads=4,
@@ -609,6 +610,56 @@ def run_serve_sweep(out_path: str, requests: int = 32,
     summary = sched.run_serve(engine, params, reqs)
     engine.assert_two_programs()
 
+    # Fixed-HBM dense-vs-paged pair: the tentpole's headline number.
+    # Size the paged pool to STRICTLY FEWER KV bytes than the dense
+    # cache (pool + trash page + page table vs slots×max_seq), then
+    # drive both with the same shared-prefix load — the paged engine
+    # must sustain strictly more concurrent sequences inside the
+    # smaller footprint (one prefix page serves every slot; tails only
+    # allocate pages they reach).
+    pair_rows = []
+    prefix_len, pair_reqs, pair_rate = 8, 24, 500.0
+    # seed must match the pair stream below — the scheduler byte-checks
+    # each prompt against the registered prefix before sharing pages
+    shared = sched.shared_prefix_tokens(prefix_len,
+                                        model_cfg.vocab_size, seed=1)
+    for mode, eng in (
+            ("dense", ServeEngine(
+                model_cfg, mesh, slots=slots, max_seq=max_seq,
+                prompt_pad=prompt_pad, decode_k=8, layout="st")),
+            ("paged", PagedServeEngine(
+                model_cfg, mesh, slots=2 * slots, max_seq=max_seq,
+                prompt_pad=prompt_pad, decode_k=8, page_tokens=8,
+                pages=30))):
+        eng.warmup(params)
+        rs = sched.make_requests(pair_reqs, prompt_pad=prompt_pad,
+                                 vocab_size=model_cfg.vocab_size,
+                                 max_new=max_new, rate=pair_rate,
+                                 seed=1, prefix_len=prefix_len)
+        summ = sched.run_serve(eng, params, rs, shared_prefix=shared)
+        eng.assert_two_programs()
+        pair_rows.append({
+            "mode": mode, "slots": eng.slots,
+            "kv_cache_bytes": eng.spec.bytes,
+            "active_slots_peak": summ["active_slots_peak"],
+            "completed": summ["completed"],
+            "tokens_per_sec": summ["tokens_per_sec"],
+            "kv_pages_used_peak": summ["kv_pages_used_peak"],
+            "shared_prefix_len": summ["shared_prefix_len"]})
+        print(json.dumps(pair_rows[-1]))
+    dense_row, paged_row = pair_rows
+    if paged_row["kv_cache_bytes"] >= dense_row["kv_cache_bytes"]:
+        raise SystemExit(
+            "serve sweep: paged KV footprint must be strictly smaller "
+            f"than dense ({paged_row['kv_cache_bytes']} vs "
+            f"{dense_row['kv_cache_bytes']} bytes)")
+    if paged_row["active_slots_peak"] <= dense_row["active_slots_peak"]:
+        raise SystemExit(
+            "serve sweep: paged engine must sustain strictly more "
+            "concurrent slots than dense at fixed HBM "
+            f"({paged_row['active_slots_peak']} vs "
+            f"{dense_row['active_slots_peak']})")
+
     art = {
         "metric": "serve_tokens_per_sec_per_chip",
         "value": summary["tokens_per_sec_per_chip"],
@@ -629,6 +680,7 @@ def run_serve_sweep(out_path: str, requests: int = 32,
                 "ttft_p99_s", "itl_p50_s", "itl_p99_s", "e2e_p50_s",
                 "e2e_p99_s", "prefill_compiles", "decode_compiles")},
             "kv_cache_bytes": engine.spec.bytes,
+            "paged_pair": pair_rows,
         },
         "slo": slo_lib.slo_block(summary),
     }

@@ -58,13 +58,15 @@ def test_rules_table_names_and_alert_subset():
     names = {t.name for t in rules_lib.THRESHOLDS}
     assert names == {"straggler", "staging", "comm", "comm_dcn",
                      "regress", "stall", "trace_drop", "ttft", "itl",
-                     "tokens_per_chip", "serve_shed", "goodput"}
-    # every rule but the artifact-quality one and the DCN threshold row
-    # is a live alert (comm_dcn is a per-fabric CEILING the comm alert
+                     "tokens_per_chip", "serve_shed", "spec_accept",
+                     "goodput"}
+    # every rule but the artifact-quality one, the DCN threshold row,
+    # and the off-by-default speculative-acceptance floor is a live
+    # alert (comm_dcn is a per-fabric CEILING the comm alert
     # substitutes via resolve_comm, not its own (rule, host) key — the
     # at-exit comm_status cross-check must find ONE matching alert)
     assert {t.name for t in rules_lib.ALERT_RULES} == names - {
-        "trace_drop", "comm_dcn"}
+        "trace_drop", "comm_dcn", "spec_accept"}
 
 
 def test_rules_resolve_comm_fabric_dispatch(monkeypatch):

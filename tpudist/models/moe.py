@@ -203,6 +203,21 @@ def _cached_hidden_states(params: Params, tokens: jax.Array,
                                    ffn=_moe_ffn_sublayer)
 
 
+def paged_hidden_states(params: Params, tokens: jax.Array,
+                        cfg: ModelConfig, *, dtype, pool_k, pool_v,
+                        page_table, positions, write_ok,
+                        page_tokens: int):
+    """Paged serving path: the transformer's paged contract verbatim
+    (:func:`transformer.paged_hidden_states`) with only the FFN half
+    swapped for the experts."""
+    return T.paged_hidden_states(params, tokens, cfg, dtype=dtype,
+                                 pool_k=pool_k, pool_v=pool_v,
+                                 page_table=page_table,
+                                 positions=positions, write_ok=write_ok,
+                                 page_tokens=page_tokens,
+                                 ffn=_moe_ffn_sublayer)
+
+
 def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,
                   dtype=jnp.bfloat16, attn_impl=T._attention,
                   rope_offset=0, rope_positions=None,

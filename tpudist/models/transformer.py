@@ -229,6 +229,27 @@ def _layer(x, lp, cfg: ModelConfig, cos, sin, attn_impl):
     return _ffn_sublayer(x, lp, cfg)
 
 
+def window_rope(x: jax.Array, positions: jax.Array,
+                theta: float) -> jax.Array:
+    """Rotate a WINDOW of new tokens per slot at their own absolute
+    positions. x: (batch, window, heads, head_dim); positions: (batch,
+    window) int32 — the windowed generalisation of :func:`decode_rope`
+    (window 1 recovers it bit-for-bit), used by the paged decode/verify
+    programs where a speculative window appends several tokens per slot
+    per dispatch. The frequency derivation stays in
+    :func:`precompute_rope` (``positions=``) so there is ONE site for
+    any future theta/interpolation change. Same pair convention as
+    apply_rope: channel i rotates with channel i + head_dim/2."""
+    b, w, _, hd = x.shape
+    cos, sin = precompute_rope(0, hd, theta,
+                               positions=positions.reshape(-1))
+    cos = cos.reshape(b, w, 1, hd // 2).astype(x.dtype)
+    sin = sin.reshape(b, w, 1, hd // 2).astype(x.dtype)
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                           axis=-1)
+
+
 def decode_rope(x: jax.Array, positions: jax.Array,
                 theta: float) -> jax.Array:
     """Rotate one new token per slot at its absolute position.
@@ -236,17 +257,10 @@ def decode_rope(x: jax.Array, positions: jax.Array,
     x: (batch, 1, heads, head_dim); positions: (batch,) int32 — each
     slot in a continuously-batched decode step sits at its OWN sequence
     position, so the table-based :func:`apply_rope` (one shared position
-    per column) does not fit; the frequency derivation itself stays in
-    :func:`precompute_rope` (``positions=``) so there is ONE site for
-    any future theta/interpolation change. Same pair convention as
-    apply_rope: channel i rotates with channel i + head_dim/2."""
-    hd = x.shape[-1]
-    cos, sin = precompute_rope(0, hd, theta, positions=positions)
-    cos = cos[:, None, None, :].astype(x.dtype)
-    sin = sin[:, None, None, :].astype(x.dtype)
-    x1, x2 = jnp.split(x, 2, axis=-1)
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
-                           axis=-1)
+    per column) does not fit. The window-1 case of
+    :func:`window_rope` (same flattened positions feed the same
+    precompute_rope call, so the delegation is bitwise)."""
+    return window_rope(x, positions[:, None], theta)
 
 
 def _cached_attention(q, k_new, v_new, cache_k, cache_v, pos):
@@ -347,6 +361,126 @@ def _cached_hidden_states(params: Params, tokens: jax.Array,
         x, (ck, cv) = lax.scan(body, x, (params["layers"], ck, cv),
                                unroll=unroll)
     return rmsnorm(x, params["final_norm"]), {"k": ck, "v": cv}
+
+
+def _paged_attention(q, k_new, v_new, pool_k, pool_v, page_table,
+                     positions, write_ok, page_tokens: int):
+    """Windowed incremental attention against a PAGED KV pool,
+    gather-free on the read path.
+
+    q: (slots, window, heads, head_dim); k_new/v_new: (slots, window,
+    kv, head_dim), ALREADY rotated at ``positions`` (slots, window);
+    pool_k/pool_v: (pages+1, page_tokens, kv, head_dim) — one layer of
+    the pool, last page the TRASH page; page_table: (slots, max_pages)
+    int32, -1 = unmapped; write_ok: (slots, window) bool — False routes
+    the write to the trash page (inactive slots, positions past
+    capacity, shared-prefix positions another slot's registration
+    already wrote).
+
+    WRITE: the only dynamic indexing is a tiny ``take_along_axis`` on
+    the int32 page table (slots × window entries) plus the scatter of
+    the new k/v — the same shape of scatter the dense path's
+    ``.at[slot, pos].set`` does. READ: no gathers at all — ownership
+    is a one-hot compare of the page table against the pool's page ids
+    (the trash page id appears in no table, so it is masked out by
+    construction), each owned page's LOGICAL position comes from the
+    same one-hot, and attention runs over the whole flattened pool with
+    ``owned & (key_pos <= query_pos)`` masking — stale pages, other
+    slots' pages and the trash page all mask to exp(-inf) = 0 exactly,
+    the same discipline that keeps the dense arena's stale rows
+    unreadable. Write-then-attend with the position mask also gives
+    intra-window causality for free: a window query at position p never
+    sees the window's own later writes (their positions exceed p).
+    Same f32-softmax discipline as :func:`_attention`."""
+    s, w, h, hd = q.shape
+    n_pool, pt = pool_k.shape[0], page_tokens
+    kv = k_new.shape[2]
+    maxp = page_table.shape[1]
+    trash = n_pool - 1
+
+    # ---- write: new k/v land at their pages (or the trash page) ----
+    j = positions // pt                                   # (s, w)
+    off = positions % pt
+    pg = jnp.take_along_axis(page_table, j, axis=1)       # (s, w)
+    pg = jnp.where(write_ok & (pg >= 0), pg, trash)
+    pool_k = pool_k.at[pg, off].set(k_new.astype(pool_k.dtype))
+    pool_v = pool_v.at[pg, off].set(v_new.astype(pool_v.dtype))
+
+    # ---- read: ownership + position masks from one one-hot ----
+    onehot = page_table[:, :, None] == jnp.arange(n_pool)[None, None, :]
+    owned = onehot.any(axis=1)                            # (s, pool)
+    logical = jnp.einsum("sjp,j->sp", onehot.astype(jnp.int32),
+                         jnp.arange(maxp, dtype=jnp.int32))
+    kpos = logical[:, :, None] * pt + jnp.arange(pt)[None, None, :]
+    mask = owned[:, None, :, None] \
+        & (kpos[:, None, :, :] <= positions[:, :, None, None])
+    mask = mask.reshape(s, w, n_pool * pt)                # (s, w, keys)
+
+    kf = pool_k.reshape(n_pool * pt, kv, hd).astype(q.dtype)
+    vf = pool_v.reshape(n_pool * pt, kv, hd).astype(q.dtype)
+    qg = q.reshape(s, w, kv, h // kv, hd)   # GQA: group per kv head
+    scores = jnp.einsum("swkgd,nkd->swkgn", qg, kf) / jnp.sqrt(
+        jnp.asarray(hd, q.dtype))
+    scores = jnp.where(mask[:, :, None, None, :], scores,
+                       jnp.asarray(-1e30, scores.dtype))
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(
+        q.dtype)
+    o = jnp.einsum("swkgn,nkd->swkgd", probs, vf).reshape(s, w, h, hd)
+    return o, pool_k, pool_v
+
+
+def _attn_sublayer_paged(x, lp, cfg: ModelConfig, positions, write_ok,
+                         pool_k, pool_v, page_table, page_tokens: int):
+    """The paged twin of :func:`_attn_sublayer_cached`: a WINDOW of new
+    tokens per slot, q/k/v projected and rotated at each token's own
+    position, attention against the layer's paged pool. Returns
+    ``(out, pool_k', pool_v')``. Shared with the MoE model, whose
+    layers differ only in the FFN half."""
+    b, w, d = x.shape
+    h, kv = cfg.n_heads, cfg.n_kv_heads
+    hd = d // h
+    dt = x.dtype
+    y = rmsnorm(x, lp["attn_norm"])
+    q = (y @ lp["wq"].astype(dt)).reshape(b, w, h, hd)
+    k = (y @ lp["wk"].astype(dt)).reshape(b, w, kv, hd)
+    v = (y @ lp["wv"].astype(dt)).reshape(b, w, kv, hd)
+    q = window_rope(q, positions, cfg.rope_theta)
+    k = window_rope(k, positions, cfg.rope_theta)
+    o, pool_k, pool_v = _paged_attention(q, k, v, pool_k, pool_v,
+                                         page_table, positions,
+                                         write_ok, page_tokens)
+    o = o.reshape(b, w, h * hd)
+    return x + o @ lp["wo"].astype(dt), pool_k, pool_v
+
+
+def paged_hidden_states(params: Params, tokens: jax.Array,
+                        cfg: ModelConfig, *, dtype, pool_k, pool_v,
+                        page_table, positions, write_ok,
+                        page_tokens: int, ffn=_ffn_sublayer):
+    """Windowed incremental forward against the PAGED KV pool — the
+    paged twin of :func:`_cached_hidden_states`'s decode branch.
+
+    tokens/positions/write_ok: (slots, window); pool_k/pool_v:
+    (n_layers, pages+1, page_tokens, kv, head_dim); page_table:
+    (slots, max_pages) int32 — a per-dispatch argument, never device
+    state (the host allocator owns it). Window 1 is the paged decode
+    step; window k is the speculative VERIFY forward (one batched
+    target forward scoring a whole draft window). ``ffn(x, lp, cfg)``
+    is the per-layer FFN half — the ONE thing the MoE model swaps.
+    Returns ``(h, pool_k', pool_v')`` with ``h`` final-normed."""
+    x = params["embed"].astype(dtype)[tokens]
+    unroll = cfg.n_layers <= 8
+
+    def body(x, xs):
+        lp, pk_l, pv_l = xs
+        x, pk_l, pv_l = _attn_sublayer_paged(
+            x, lp, cfg, positions, write_ok, pk_l, pv_l, page_table,
+            page_tokens)
+        return ffn(x, lp, cfg), (pk_l, pv_l)
+
+    x, (pool_k, pool_v) = lax.scan(
+        body, x, (params["layers"], pool_k, pool_v), unroll=unroll)
+    return rmsnorm(x, params["final_norm"]), pool_k, pool_v
 
 
 def hidden_states(params: Params, tokens: jax.Array, cfg: ModelConfig, *,

@@ -520,7 +520,8 @@ class LiveAggregator:
                       "generated_tokens", "ttft_p99_s", "itl_p99_s",
                       "tokens_per_sec_per_chip", "status",
                       "shed_total", "shed_fraction", "adapt_level",
-                      "decode_k"):
+                      "decode_k", "kv_pages_used", "kv_pages_total",
+                      "spec_accept_rate"):
                 if rec.get(k) is not None:
                     sv[k] = rec[k]
             step = sv.get("completed")
@@ -869,6 +870,11 @@ _PROM_HELP = {
                                    "serve_shed gate's observable).",
     "tpudist_serve_adapt_level": "Graceful-degradation ladder level "
                                  "(0 = full service).",
+    "tpudist_serve_kv_pages_used": "KV cache pages currently held "
+                                   "(slots + shared-prefix registry).",
+    "tpudist_serve_kv_pages_total": "KV cache pool capacity in pages.",
+    "tpudist_serve_spec_accept_rate": "Fraction of drafted tokens the "
+                                      "target model accepted.",
     "tpudist_alert_firing": "1 while the named alert rule fires.",
     "tpudist_alerts_total": "Alert fire/resolve transitions so far.",
     "tpudist_records_total": "Telemetry records ingested.",
@@ -968,6 +974,12 @@ def prometheus_text(status: Dict[str, Any]) -> str:
     metric("tpudist_serve_shed_fraction",
            [({}, sv.get("shed_fraction"))])
     metric("tpudist_serve_adapt_level", [({}, sv.get("adapt_level"))])
+    metric("tpudist_serve_kv_pages_used",
+           [({}, sv.get("kv_pages_used"))])
+    metric("tpudist_serve_kv_pages_total",
+           [({}, sv.get("kv_pages_total"))])
+    metric("tpudist_serve_spec_accept_rate",
+           [({}, sv.get("spec_accept_rate"))])
     # one series per alert RULE: 1 when any (rule, host) key fires —
     # a fixed label set scrapers can alert on without knowing hosts
     firing_rules = {a["alert"] for a in alerts.get("firing", [])}

@@ -64,6 +64,14 @@ TOKENS_PER_CHIP_MIN = 1.0   # serve: decode throughput floor (tok/s/chip)
 # transient 2x bursts (~half the arrivals shed at sustained 2x) without
 # flagging; capacity-planned deployments tighten it via the env override.
 SERVE_SHED_MAX = 0.6        # serve: max shed fraction of arrivals
+# Speculative-decoding acceptance (tpudist.serve): the fraction of
+# drafted tokens the target model confirmed. A low rate means the
+# n-gram proposer is guessing badly for this workload and the verify
+# passes are burning flops for nothing — an efficiency finding, not a
+# correctness one (speculation is bitwise-exact at any rate), so the
+# default floor is 0.0 (never breaches) and the rule never alerts
+# mid-run; deployments that care opt in via the env override.
+SPEC_ACCEPT_MIN = 0.0       # serve: min speculative acceptance rate
 
 # Goodput (tpudist.obs.goodput): productive training time as a fraction
 # of the run's total wall-clock — cross-attempt in the offline ledger,
@@ -173,6 +181,16 @@ THRESHOLDS: Tuple[Threshold, ...] = (
         description="past this the admission controller is the only "
                     "thing meeting the latency SLO — the pod is "
                     "under-provisioned for its offered load"),
+    Threshold(
+        name="spec_accept", env="TPUDIST_SERVE_SPEC_ACCEPT_MIN",
+        default=SPEC_ACCEPT_MIN, sense="min", alert=False,
+        observable="fraction of drafted tokens the target model "
+                   "accepted across the run",
+        description="below this the n-gram draft is a poor fit for the "
+                    "workload and the verify passes waste flops — an "
+                    "efficiency gate (speculation is bitwise-exact at "
+                    "any rate), off by default (floor 0.0) and never a "
+                    "mid-run alert"),
     Threshold(
         name="goodput", env="TPUDIST_GOODPUT_MIN",
         default=GOODPUT_MIN, sense="min", alert=True,

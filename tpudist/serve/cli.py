@@ -71,6 +71,34 @@ def parse_args(argv: Optional[Sequence[str]] = None
     p.add_argument("--kv-layout", choices=("st", "hs"), default="st",
                    help="KV cache physical storage layout "
                         "(tpudist.serve.kvcache)")
+    # ---- the paged plane (PagedServeEngine) ----
+    p.add_argument("--kv-page-tokens", type=int,
+                   default=_env_int("TPUDIST_SERVE_KV_PAGE_TOKENS")
+                   or 0,
+                   help="PAGED KV cache: fixed page length in "
+                        "positions; 0 keeps the dense per-slot arena "
+                        "($TPUDIST_SERVE_KV_PAGE_TOKENS)")
+    p.add_argument("--kv-pages", type=int,
+                   default=_env_int("TPUDIST_SERVE_KV_PAGES") or 0,
+                   help="paged pool size in pages (+1 trash page is "
+                        "added internally); 0 = full dense capacity "
+                        "slots*ceil(max_seq/page_tokens) "
+                        "($TPUDIST_SERVE_KV_PAGES)")
+    p.add_argument("--shared-prefix", type=int,
+                   default=_env_int("TPUDIST_SERVE_SHARED_PREFIX")
+                   or 0,
+                   help="every request starts with this many shared "
+                        "system-prompt tokens; the paged engine stores "
+                        "their full pages ONCE (refcounted, "
+                        "copy-on-write fork of the partial tail) "
+                        "($TPUDIST_SERVE_SHARED_PREFIX)")
+    p.add_argument("--speculate-k", type=int,
+                   default=_env_int("TPUDIST_SERVE_SPECULATE_K") or 0,
+                   help="speculative decoding verify-window width: "
+                        "last token + k-1 n-gram draft tokens scored "
+                        "in ONE batched target forward; 0 = off, "
+                        "needs --kv-page-tokens "
+                        "($TPUDIST_SERVE_SPECULATE_K)")
     p.add_argument("--requests", type=int, default=32,
                    help="synthetic request count")
     p.add_argument("--request-rate", type=float, default=0.0,
@@ -220,7 +248,8 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
     from tpudist.parallel.mesh import build_mesh
     from tpudist.serve import scheduler as sched
     from tpudist.serve import tune as serve_tune
-    from tpudist.serve.engine import ServeEngine, init_params
+    from tpudist.serve.engine import (PagedServeEngine, ServeEngine,
+                                      init_params)
 
     model_cfg = ModelConfig(
         name=args.model, vocab_size=args.vocab_size,
@@ -293,8 +322,13 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
 
     params = init_params(model_cfg, mesh, seed=args.seed)
 
-    cand = serve_tune.ServeCandidate(decode_k=args.decode_k,
-                                     layout=args.kv_layout)
+    if args.speculate_k and not args.kv_page_tokens:
+        raise SystemExit("tpudist: --speculate-k needs the paged KV "
+                         "cache (--kv-page-tokens > 0)")
+    cand = serve_tune.ServeCandidate(
+        decode_k=args.decode_k, layout=args.kv_layout,
+        kv_page_tokens=max(args.kv_page_tokens, 0),
+        speculate_k=max(args.speculate_k, 0))
     if args.serve_tune != "off":
         cache_dir = (args.tune_cache_dir
                      or os.environ.get("TPUDIST_AUTOTUNE_CACHE_DIR")
@@ -309,22 +343,38 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
         cand = out.tuned
         log0(f"tpudist: serve tune {out.status} ({out.source}): "
              f"decode_k={cand.decode_k} layout={cand.layout} "
+             f"kv_page_tokens={cand.kv_page_tokens} "
+             f"speculate_k={cand.speculate_k} "
              f"[{out.trials} trial(s)]")
 
     ladder = (res_lib.default_ladder(cand.decode_k)
               if resilience.adapt else None)
-    engine = ServeEngine(model_cfg, mesh, slots=args.slots,
-                         max_seq=args.max_seq,
-                         prompt_pad=args.prompt_pad,
-                         decode_k=cand.decode_k, layout=cand.layout,
-                         adapt_ladder=ladder)
+    if cand.kv_page_tokens > 0:
+        engine = PagedServeEngine(
+            model_cfg, mesh, slots=args.slots, max_seq=args.max_seq,
+            prompt_pad=args.prompt_pad, decode_k=cand.decode_k,
+            page_tokens=cand.kv_page_tokens,
+            pages=max(args.kv_pages, 0),
+            speculate_k=max(cand.speculate_k, 0),
+            adapt_ladder=ladder)
+    else:
+        engine = ServeEngine(model_cfg, mesh, slots=args.slots,
+                             max_seq=args.max_seq,
+                             prompt_pad=args.prompt_pad,
+                             decode_k=cand.decode_k, layout=cand.layout,
+                             adapt_ladder=ladder)
     with trace_lib.span("serve_warmup", cat="serve"):
         engine.warmup(params)
 
+    prefix_len = max(args.shared_prefix, 0)
+    shared_prefix = (sched.shared_prefix_tokens(
+        min(prefix_len, args.prompt_pad), args.vocab_size, args.seed)
+        if prefix_len else None)
     requests = sched.make_requests(
         args.requests, prompt_pad=args.prompt_pad,
         vocab_size=args.vocab_size, max_new=args.max_new_tokens,
-        rate=args.request_rate, seed=args.seed)
+        rate=args.request_rate, seed=args.seed,
+        prefix_len=prefix_len)
     if chaos_rt is not None:
         # request_garbage: the fault IS the malformed requests — fold
         # them into the (deterministic) schedule; admission rejects
@@ -369,7 +419,8 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
     summary = sched.run_serve(engine, params, requests, metrics=metrics,
                               resilience=resilience, chaos=chaos_rt,
                               virtual=virtual,
-                              flush_events=True if supervised else None)
+                              flush_events=True if supervised else None,
+                              shared_prefix=shared_prefix)
     engine.assert_two_programs()
 
     summary["run_id"] = run_id
@@ -400,8 +451,13 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
          f"ttft p99 {summary['ttft_p99_s']}s, "
          f"itl p99 {summary['itl_p99_s']}s "
          f"[{summary['prefill_compiles']} prefill / "
-         f"{summary['decode_compiles']} decode compile(s), "
-         f"kv cache {cache_bytes / 2**20:.2f} MB]")
+         f"{summary['decode_compiles']} decode / "
+         f"{summary['verify_compiles']} verify compile(s), "
+         f"kv cache {cache_bytes / 2**20:.2f} MB"
+         + (f", {summary['kv_pages_used_peak']}"
+            f"/{summary['kv_pages_total']} pages peak, "
+            f"spec accept {summary['spec_accept_rate']}"
+            if getattr(engine, "paged", False) else "") + "]")
 
     if args.bench_out:
         _write_bench(args.bench_out, args, summary)
@@ -437,11 +493,14 @@ def _write_bench(path: str, args: argparse.Namespace,
             "tokens_per_sec", "queue_depth_max", "queue_depth_mean",
             "ttft_p50_s", "ttft_p99_s", "itl_p50_s", "itl_p99_s",
             "e2e_p50_s", "e2e_p99_s", "prefill_compiles",
-            "decode_compiles", "n_chips",
+            "decode_compiles", "verify_compiles", "n_chips",
             "arrived", "admitted", "shed_at_admission",
             "expired_in_queue", "rejected", "lost", "completed_prior",
             "shed_fraction", "queue_cap", "ttft_deadline_s",
-            "adapt_level", "decode_k_ladder", "requeue_attempt")},
+            "adapt_level", "decode_k_ladder", "requeue_attempt",
+            "kv_page_tokens", "kv_pages_total", "kv_pages_used_peak",
+            "active_slots_peak", "spec_accept_rate", "speculate_k",
+            "shared_prefix_len")},
         "slo": slo_lib.slo_block(summary),
         "device": jax.devices()[0].device_kind,
     }

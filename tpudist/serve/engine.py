@@ -38,6 +38,7 @@ from typing import Any, Callable, NamedTuple, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 from tpudist.config import ModelConfig
@@ -85,6 +86,9 @@ class ServeEngine:
     The default ladder is ``(decode_k,)``, which keeps the original
     two-program contract bit-for-bit.
     """
+
+    paged = False          # the scheduler branches on this, not on type
+    speculate_k = 0        # speculation is a paged-engine feature
 
     def __init__(self, model_cfg: ModelConfig, mesh, *, slots: int,
                  max_seq: int, prompt_pad: int, decode_k: int = 8,
@@ -299,3 +303,351 @@ class ServeEngine:
                 f"serve engine compiled {p} prefill / {d} decode "
                 f"program(s), expected {want[0]}/{want[1]} for ladder "
                 f"{self.ladder}; the two-program contract is broken")
+
+
+class PagedServeState(NamedTuple):
+    """Device-resident PAGED serving state. Unlike :class:`ServeState`
+    there is no per-slot cache arena: K/V live in one shared pool of
+    fixed-size pages (+1 trash page) and the slot→page mapping is HOST
+    state (``PageAllocator.table``), passed into every dispatch as a
+    small traced int32 array."""
+
+    pool_k: jax.Array        # (L, pages+1, page_tokens, kv, head_dim)
+    pool_v: jax.Array
+    lengths: jax.Array       # (slots,) int32: tokens in cache per slot
+    last_token: jax.Array    # (slots,) int32: newest token, not yet cached
+    active: jax.Array        # (slots,) bool: slot holds a live sequence
+    remaining: jax.Array     # (slots,) int32: generation budget left
+
+
+class PagedServeEngine(ServeEngine):
+    """The paged + shared-prefix + speculative serving engine.
+
+    Same compiled-program discipline as the dense engine — ONE prefill
+    program, one decode program per ladder rung — generalised by one
+    more pinned program when speculation is on: the VERIFY forward, a
+    single batched target forward over a ``speculate_k``-token window
+    per slot that scores a whole host-proposed draft at once. Page
+    table and per-dispatch active mask ride as small traced arrays
+    (fixed shapes → no retrace); admission, eviction, page exhaustion
+    and drafting are pure host decisions between dispatches.
+
+    ``speculate_k`` is the verify WINDOW width: the window carries the
+    slot's pending ``last_token`` plus ``speculate_k - 1`` draft tokens,
+    so ``speculate_k >= 2`` turns speculation on (a window of 1 is
+    plain decode) and ``0`` turns it off. Greedy token output is
+    bitwise-identical to non-speculative greedy decode by construction:
+    every emitted token is the argmax after a verified-correct token,
+    and rejected drafts' junk KV sits at positions beyond the new
+    length, where write-then-attend overwrites it before any query can
+    attend it.
+    """
+
+    paged = True
+
+    def __init__(self, model_cfg: ModelConfig, mesh, *, slots: int,
+                 max_seq: int, prompt_pad: int, decode_k: int = 8,
+                 page_tokens: int = 8, pages: int = 0,
+                 speculate_k: int = 0, dtype=jnp.float32,
+                 adapt_ladder: Optional[Sequence[int]] = None):
+        super().__init__(model_cfg, mesh, slots=slots, max_seq=max_seq,
+                         prompt_pad=prompt_pad, decode_k=decode_k,
+                         layout="st", dtype=dtype,
+                         adapt_ladder=adapt_ladder)
+        if speculate_k == 1 or speculate_k < 0:
+            raise ValueError(
+                f"--speculate-k must be 0 (off) or >= 2 (window of "
+                f"last_token + drafts), got {speculate_k}")
+        self.speculate_k = int(speculate_k)
+        self.spec = kvcache.PagedCacheSpec.from_model(
+            model_cfg, slots=slots, max_seq=max_seq,
+            page_tokens=page_tokens, pages=pages, dtype=dtype)
+        self.page_tokens = self.spec.page_tokens
+        self.alloc = kvcache.PageAllocator(self.spec)
+        self.verify_traces: list = []
+        self._prefill = jax.jit(self._paged_prefill_body,
+                                donate_argnums=(1,))
+        self._decode = jax.jit(self._paged_decode_body,
+                               static_argnums=(2,), donate_argnums=(1,))
+        self._verify = jax.jit(self._paged_verify_body,
+                               donate_argnums=(1,))
+
+    def new_allocator(self) -> kvcache.PageAllocator:
+        """Fresh page bookkeeping (drops any shared-prefix registry) —
+        one allocator per serve run, like one state per run."""
+        self.alloc = kvcache.PageAllocator(self.spec)
+        return self.alloc
+
+    # ----------------------------------------------------------- state
+
+    def init_state(self) -> PagedServeState:
+        cache = kvcache.init_paged_cache(self.spec, self.mesh)
+        rep = shd.replicated(self.mesh)
+        vec = lambda v: jax.device_put(v, rep)
+        s = self.slots
+        return PagedServeState(
+            pool_k=cache["k"], pool_v=cache["v"],
+            lengths=vec(jnp.zeros((s,), jnp.int32)),
+            last_token=vec(jnp.zeros((s,), jnp.int32)),
+            active=vec(jnp.zeros((s,), bool)),
+            remaining=vec(jnp.zeros((s,), jnp.int32)))
+
+    # --------------------------------------------------------- prefill
+
+    def _paged_prefill_body(self, params, state: PagedServeState,
+                            tokens, prompt_len, slot, max_new, page_row,
+                            shared_len
+                            ) -> Tuple[PagedServeState, jax.Array]:
+        self.prefill_traces.append(1)   # trace-time compile marker
+        spec = self.spec
+        pt = spec.page_tokens
+        # dense prefill into a throwaway scratch row — the model's
+        # existing cache-aware full forward, so the K/V bytes are
+        # BITWISE the ones the dense engine would store — then scatter
+        # the slot's true positions into its pages. Positions below
+        # ``shared_len`` are skipped (their pages are the shared prefix,
+        # already holding bitwise-identical content); the padded tail
+        # and the skipped prefix route to the trash page.
+        scratch_shape = (spec.n_layers, 1, self.prompt_pad,
+                         spec.n_kv_heads, spec.head_dim)
+        scratch = {"k": jnp.zeros(scratch_shape, self.dtype),
+                   "v": jnp.zeros(scratch_shape, self.dtype)}
+        h, scratch = self.model.hidden_states(
+            params, tokens, self.model_cfg, dtype=self.dtype,
+            kv_cache=scratch, cur_index=None)
+        h_last = lax.dynamic_index_in_dim(h, prompt_len - 1, axis=1,
+                                          keepdims=False)
+        first = jnp.argmax(self._tied_logits(params, h_last),
+                           axis=-1).astype(jnp.int32)[0]
+        t = jnp.arange(self.prompt_pad)
+        write = (t >= shared_len) & (t < prompt_len)
+        pg = page_row[t // pt]
+        pg = jnp.where(write & (pg >= 0), pg, spec.pages)  # else: trash
+        off = t % pt
+        pk = state.pool_k.at[:, pg, off].set(scratch["k"][:, 0])
+        pv = state.pool_v.at[:, pg, off].set(scratch["v"][:, 0])
+        rem = max_new - 1            # the prefill itself produced token 1
+        active = (rem > 0) & (prompt_len < self.max_seq)
+        return PagedServeState(
+            pool_k=pk, pool_v=pv,
+            lengths=state.lengths.at[slot].set(prompt_len),
+            last_token=state.last_token.at[slot].set(first),
+            active=state.active.at[slot].set(active),
+            remaining=state.remaining.at[slot].set(
+                jnp.where(active, rem, 0))), first
+
+    def prefill(self, params, state: PagedServeState, tokens,
+                prompt_len: int, slot: int, max_new: int,
+                page_row=None, shared_len: int = 0
+                ) -> Tuple[PagedServeState, jax.Array]:
+        """Admit one request into ``slot``: the dense contract plus the
+        slot's page-table ROW (defaults to the allocator's current row
+        for ``slot``) and the shared-prefix watermark ``shared_len``
+        (``alloc.admit_shared_len``) — both traced, one program."""
+        tokens = jnp.asarray(tokens, jnp.int32).reshape(1, self.prompt_pad)
+        if page_row is None:
+            page_row = self.alloc.row(slot)
+        page_row = jnp.asarray(page_row, jnp.int32).reshape(
+            self.spec.max_pages_per_slot)
+        return self._prefill(params, state, tokens,
+                             jnp.int32(prompt_len), jnp.int32(slot),
+                             jnp.int32(max_new), page_row,
+                             jnp.int32(shared_len))
+
+    def register_prefix(self, params, state: PagedServeState,
+                        prefix_tokens, prefix_len: int
+                        ) -> PagedServeState:
+        """Cache a shared system-prompt prefix ONCE, for every future
+        admission: reserve its full pages (registry-held, refcounted)
+        and fill them by running the ONE compiled prefill program —
+        width ``prompt_pad``, ``max_new=1`` so the probe slot comes
+        back inactive and its scalar entries are overwritten by the
+        slot's real admission later. Causal masking makes the stored
+        K/V bitwise-identical to what any full prompt starting with
+        this prefix would compute for those positions. The partial tail
+        page (``prefix_len % page_tokens`` positions) routes to trash
+        here; admissions recompute it into their first private page —
+        the copy-on-write fork, done eagerly by recomputation."""
+        pages = self.alloc.register_shared(prefix_len)
+        if not pages:
+            return state
+        row = np.full((self.spec.max_pages_per_slot,), -1, np.int32)
+        row[:len(pages)] = pages
+        padded = np.zeros((self.prompt_pad,), np.int32)
+        padded[:prefix_len] = np.asarray(prefix_tokens)[:prefix_len]
+        state, first = self.prefill(params, state, padded,
+                                    prefix_len, 0, 1,
+                                    page_row=row, shared_len=0)
+        jax.device_get(first)
+        return state
+
+    # ---------------------------------------------------------- decode
+
+    def _paged_decode_body(self, params, state: PagedServeState, k: int,
+                           page_table, dispatch_active
+                           ) -> Tuple[PagedServeState, jax.Array,
+                                      jax.Array]:
+        self.decode_traces.append(k)    # trace-time compile marker
+        slots = self.slots
+
+        def step(st: PagedServeState, _):
+            def run(st: PagedServeState):
+                act = st.active & dispatch_active
+                pos = jnp.minimum(st.lengths, self.max_seq - 1)
+                h, pk, pv = self.model.paged_hidden_states(
+                    params, st.last_token[:, None], self.model_cfg,
+                    dtype=self.dtype, pool_k=st.pool_k, pool_v=st.pool_v,
+                    page_table=page_table, positions=pos[:, None],
+                    write_ok=(act & (st.lengths < self.max_seq))[:, None],
+                    page_tokens=self.spec.page_tokens)
+                nxt = jnp.argmax(self._tied_logits(params, h[:, 0]),
+                                 axis=-1).astype(jnp.int32)
+                new_len = jnp.where(act, st.lengths + 1, st.lengths)
+                new_rem = jnp.where(act, st.remaining - 1, st.remaining)
+                # slots OUTSIDE this dispatch (their page rows may be
+                # stale) keep their activity untouched
+                new_active = jnp.where(
+                    dispatch_active,
+                    act & (new_rem > 0) & (new_len < self.max_seq),
+                    st.active)
+                new_state = PagedServeState(
+                    pool_k=pk, pool_v=pv, lengths=new_len,
+                    last_token=jnp.where(act, nxt, st.last_token),
+                    active=new_active, remaining=new_rem)
+                return new_state, jnp.where(act, nxt, -1), act
+
+            def skip(st: PagedServeState):
+                return (st, jnp.full((slots,), -1, jnp.int32),
+                        jnp.zeros((slots,), bool))
+
+            st, tok, valid = lax.cond(
+                (st.active & dispatch_active).any(), run, skip, st)
+            return st, (tok, valid)
+
+        state, (toks, valid) = lax.scan(step, state, None, length=k)
+        return state, toks, valid
+
+    def decode(self, params, state: PagedServeState,
+               k: Optional[int] = None, dispatch_active=None
+               ) -> Tuple[PagedServeState, jax.Array, jax.Array]:
+        """One paged decode superstep. The CURRENT page table (the host
+        allocator's) and the dispatch's slot mask go in as small traced
+        int32/bool arrays — fixed shapes, so every dispatch reuses the
+        rung's one compiled program."""
+        k = self.decode_k if k is None else int(k)
+        if k not in self.ladder:
+            raise ValueError(
+                f"decode k={k} is not a warmed ladder rung "
+                f"{self.ladder}")
+        table = jnp.asarray(self.alloc.table, jnp.int32)
+        if dispatch_active is None:
+            da = jnp.ones((self.slots,), bool)
+        else:
+            da = jnp.asarray(dispatch_active, bool).reshape(self.slots)
+        return self._decode(params, state, k, table, da)
+
+    # ---------------------------------------------------------- verify
+
+    def _paged_verify_body(self, params, state: PagedServeState, draft,
+                           page_table, dispatch_active):
+        self.verify_traces.append(1)    # trace-time compile marker
+        w = self.speculate_k
+        act = state.active & dispatch_active
+        # window w=0 is the slot's pending last_token (always correct);
+        # w>=1 are the host proposer's draft tokens
+        toks_in = jnp.concatenate([state.last_token[:, None], draft],
+                                  axis=1)                      # (S, W)
+        offs = jnp.arange(w, dtype=jnp.int32)[None, :]
+        raw_pos = state.lengths[:, None] + offs
+        pos = jnp.minimum(raw_pos, self.max_seq - 1)
+        write_ok = act[:, None] & (raw_pos < self.max_seq)
+        h, pk, pv = self.model.paged_hidden_states(
+            params, toks_in, self.model_cfg, dtype=self.dtype,
+            pool_k=state.pool_k, pool_v=state.pool_v,
+            page_table=page_table, positions=pos, write_ok=write_ok,
+            page_tokens=self.spec.page_tokens)
+        g = jnp.argmax(self._tied_logits(params, h),
+                       axis=-1).astype(jnp.int32)              # (S, W)
+        # draft token w-1 is correct iff all earlier drafts matched the
+        # target's greedy choice — cumprod counts the accepted run
+        match = (draft == g[:, :-1]).astype(jnp.int32)
+        a = jnp.cumprod(match, axis=1).sum(axis=1)             # (S,)
+        # emit the accepted run + the target's one bonus token, clamped
+        # to the generation budget and the cache capacity (>= 1 for any
+        # active slot: active implies remaining > 0 and lengths <
+        # max_seq)
+        e = jnp.minimum(a + 1, jnp.minimum(
+            state.remaining, self.max_seq - state.lengths))
+        e = jnp.where(act, e, 0)
+        valid = act[:, None] & (offs < e[:, None])             # (S, W)
+        toks = jnp.where(valid, g, -1)
+        new_last = jnp.take_along_axis(
+            g, jnp.maximum(e - 1, 0)[:, None], axis=1)[:, 0]
+        new_len = jnp.where(act, state.lengths + e, state.lengths)
+        new_rem = jnp.where(act, state.remaining - e, state.remaining)
+        new_active = jnp.where(
+            dispatch_active,
+            act & (new_rem > 0) & (new_len < self.max_seq),
+            state.active)
+        new_state = PagedServeState(
+            pool_k=pk, pool_v=pv, lengths=new_len,
+            last_token=jnp.where(act, new_last, state.last_token),
+            active=new_active, remaining=new_rem)
+        return new_state, toks.T, valid.T, e
+
+    def verify(self, params, state: PagedServeState, draft,
+               dispatch_active=None):
+        """Score a ``(slots, speculate_k - 1)`` host draft in ONE
+        batched target forward (the speculative-decoding verify).
+        Returns ``(state, tokens (speculate_k, slots), valid
+        (speculate_k, slots), emitted (slots,))`` — the same
+        ``(tokens, valid)`` orientation as :meth:`decode`, so the
+        scheduler consumes both identically; ``emitted`` counts each
+        slot's accepted-run + bonus tokens this dispatch. Rejected
+        drafts' junk K/V lands beyond the new length and is overwritten
+        (write-then-attend) before any query can reach it, which is
+        what makes greedy output bitwise speculation-free."""
+        if self.speculate_k < 2:
+            raise ValueError("verify() requires speculate_k >= 2")
+        draft = jnp.asarray(draft, jnp.int32).reshape(
+            self.slots, self.speculate_k - 1)
+        table = jnp.asarray(self.alloc.table, jnp.int32)
+        if dispatch_active is None:
+            da = jnp.ones((self.slots,), bool)
+        else:
+            da = jnp.asarray(dispatch_active, bool).reshape(self.slots)
+        return self._verify(params, state, draft, table, da)
+
+    # ---------------------------------------------------------- warmup
+
+    def warmup(self, params) -> None:
+        """Compile prefill + every decode rung (+ verify when
+        speculating) off the request clock, on a throwaway state and a
+        junk page table (compilation only sees shapes; the junk writes
+        route to the trash page)."""
+        state = self.init_state()
+        dummy = jnp.zeros((1, self.prompt_pad), jnp.int32)
+        row = np.full((self.spec.max_pages_per_slot,), -1, np.int32)
+        state, first = self.prefill(params, state, dummy, 1, 0, 2,
+                                    page_row=row)
+        jax.device_get(first)
+        for k in self.ladder:
+            state, toks, valid = self.decode(params, state, k)
+            jax.device_get((toks, valid))
+        if self.speculate_k >= 2:
+            draft = np.zeros((self.slots, self.speculate_k - 1),
+                             np.int32)
+            state, toks, valid, e = self.verify(params, state, draft)
+            jax.device_get((toks, valid, e))
+
+    def assert_two_programs(self) -> None:
+        """The dense pin (1 prefill + 1 decode per rung) plus exactly
+        one verify program when speculation is on."""
+        super().assert_two_programs()
+        want = 1 if self.speculate_k >= 2 else 0
+        v = len(self.verify_traces)
+        if v != want:
+            raise AssertionError(
+                f"paged serve engine compiled {v} verify program(s), "
+                f"expected {want} (speculate_k={self.speculate_k}); "
+                f"the program-budget pin is broken")

@@ -1,37 +1,63 @@
-"""Sharded per-sequence KV cache for the serving engine.
+"""Sharded KV cache for the serving engine: dense arena OR paged pool.
 
-The cache is the serving engine's whole working state: one K and one V
-array of canonical shape ``(n_layers, slots, max_seq, n_kv_heads,
-head_dim)``. Slots are per-SEQUENCE pages — a request is admitted into a
-free slot, decodes in place, and frees the slot on completion; stale
-rows beyond a slot's current length are never read (the decode mask is
-``key_pos <= cur_index``), so admission never needs to zero anything.
+Two storage disciplines, one module:
 
-GQA-aware by construction: the cache stores the COMPACT kv heads (the
-same layout the models' ``wk``/``wv`` produce) and expansion to the
-query head count happens inside the attention math — an 8×-grouped
-model's cache is 8× smaller than a naive full-head cache, which is the
-difference between fitting long contexts in HBM or not.
+* **Dense arena** (:class:`CacheSpec`, the original): one K and one V
+  array of canonical shape ``(n_layers, slots, max_seq, n_kv_heads,
+  head_dim)`` — one private ``max_seq``-long row per slot. Simple, but
+  HBM scales with ``slots × max_seq`` even when most slots hold short
+  sequences, and an identical system-prompt prefix is stored once per
+  concurrent request.
+* **Paged pool** (:class:`PagedCacheSpec` + :class:`PageAllocator`,
+  vLLM-style): fixed-size pages of ``page_tokens`` positions in a pool
+  of ``pages`` (+1 sacrificial TRASH page), mapped to slots through a
+  host-owned slot→page table. A slot only holds pages for positions it
+  has actually written, so the pool can be sized well below
+  ``slots × max_seq`` — the freed HBM becomes sustained concurrency.
+  Full prefix pages of a common system prompt are REFCOUNTED and shared
+  across every slot (``register_shared``); the partial tail page is
+  "forked" copy-on-write at admission (the prefill recomputes those
+  positions into the slot's first private page — bitwise-identical
+  content, same tokens at the same absolute positions), so no slot ever
+  writes a shared page. Invalid/masked writes are routed to the trash
+  page (pool index ``pages``), which no page table ever references and
+  the ownership mask therefore never reads.
+
+The page table itself never lives on device state: the HOST allocator
+owns it and each dispatch passes the current table in as a small traced
+int32 array — the compiled programs stay exactly the programs the
+two-program discipline pinned (tpudist.serve.engine), and admission /
+eviction / page exhaustion are pure host decisions between dispatches.
+
+GQA-aware by construction either way: the cache stores the COMPACT kv
+heads (the same layout the models' ``wk``/``wv`` produce) and expansion
+to the query head count happens inside the attention math — an
+8×-grouped model's cache is 8× smaller than a naive full-head cache,
+which is the difference between fitting long contexts in HBM or not.
 
 Sharding rides the existing mesh machinery: ``parallel.sharding.
-kv_cache_specs`` is the ``param_specs``-style single source for the
-PartitionSpec (slots over the batch axes, kv heads over tensor),
-sanitised per-mesh exactly like model params.
+kv_cache_specs`` / ``paged_kv_cache_specs`` are the ``param_specs``-
+style single sources for the PartitionSpecs (slots — or pages — over
+the batch axes, kv heads over tensor), sanitised per-mesh exactly like
+model params.
 
-``layout`` is a PHYSICAL storage knob the serve autotuner probes:
-``"st"`` (canonical, seq-major) or ``"hs"`` (heads-major). The models'
-cache API always sees canonical; :func:`to_canonical` /
-:func:`from_canonical` transpose inside the compiled program, so the
-layout's real cost/benefit is exactly what the probe measures.
+``layout`` is a PHYSICAL storage knob the serve autotuner probes for
+the dense arena: ``"st"`` (canonical, seq-major) or ``"hs"``
+(heads-major). The models' cache API always sees canonical;
+:func:`to_canonical` / :func:`from_canonical` transpose inside the
+compiled program, so the layout's real cost/benefit is exactly what
+the probe measures. The paged pool has one physical layout (pages are
+already the placement unit).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpudist.config import ModelConfig
 from tpudist.parallel import sharding as shd
@@ -117,3 +143,264 @@ def init_cache(spec: CacheSpec, mesh=None) -> Dict[str, jax.Array]:
         k = jax.device_put(k, sh)
         v = jax.device_put(v, sh)
     return {"k": k, "v": v}
+
+
+# ------------------------------------------------------------------ #
+# paged pool                                                          #
+# ------------------------------------------------------------------ #
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedCacheSpec:
+    """Static shape/dtype of one serving run's PAGED KV pool.
+
+    ``pages`` is the usable pool size; the physical pool carries one
+    extra sacrificial TRASH page at index ``pages`` where every
+    masked/invalid write is routed (a page table never references it,
+    so the ownership mask never reads it — the paged twin of the dense
+    arena's clamped junk writes). ``page_tokens`` is the fixed page
+    length in positions; ``max_pages_per_slot`` is the page-table row
+    width (``ceil(max_seq / page_tokens)``)."""
+
+    n_layers: int
+    slots: int
+    max_seq: int
+    n_kv_heads: int
+    head_dim: int
+    page_tokens: int
+    pages: int
+    dtype: Any = jnp.float32
+
+    @classmethod
+    def from_model(cls, cfg: ModelConfig, *, slots: int, max_seq: int,
+                   page_tokens: int, pages: int = 0,
+                   dtype=jnp.float32) -> "PagedCacheSpec":
+        if not 0 < page_tokens <= max_seq:
+            raise ValueError(
+                f"--kv-page-tokens {page_tokens} must be in (0, "
+                f"max_seq {max_seq}]")
+        maxp = -(-max_seq // page_tokens)
+        if pages <= 0:
+            # default pool = full dense capacity: correctness-neutral
+            # sizing (admission can never be denied); operators shrink
+            # it to trade capacity for sustained concurrency
+            pages = slots * maxp
+        return cls(n_layers=cfg.n_layers, slots=slots, max_seq=max_seq,
+                   n_kv_heads=cfg.n_kv_heads,
+                   head_dim=cfg.d_model // cfg.n_heads,
+                   page_tokens=int(page_tokens), pages=int(pages),
+                   dtype=dtype)
+
+    @property
+    def max_pages_per_slot(self) -> int:
+        return -(-self.max_seq // self.page_tokens)
+
+    @property
+    def pool_shape(self) -> tuple:
+        # +1: the trash page
+        return (self.n_layers, self.pages + 1, self.page_tokens,
+                self.n_kv_heads, self.head_dim)
+
+    @property
+    def table_bytes(self) -> int:
+        return self.slots * self.max_pages_per_slot * 4   # int32 table
+
+    @property
+    def bytes(self) -> int:
+        """The PAGED footprint: pool pages (trash included — it is
+        real HBM) × page bytes for K and V, plus the page-table
+        overhead. This is the number serve_tick / BENCH_SERVE report,
+        so the fixed-HBM-budget acceptance claim is measured against
+        what is actually allocated, not the dense formula."""
+        n = 1
+        for d in self.pool_shape:
+            n *= d
+        return 2 * n * jnp.dtype(self.dtype).itemsize + self.table_bytes
+
+
+def paged_cache_shardings(spec: PagedCacheSpec, mesh) -> Any:
+    """NamedSharding for the paged K/V pools: pages ride the batch axes
+    (the pool's embarrassingly-parallel dim, like slots in the dense
+    arena), kv heads ride tensor — sanitised like model params."""
+    shape = jax.ShapeDtypeStruct(spec.pool_shape, spec.dtype)
+    pspec = shd.sanitize_specs(shape, shd.paged_kv_cache_specs(), mesh)
+    return shd.named(mesh, pspec)
+
+
+def init_paged_cache(spec: PagedCacheSpec, mesh=None
+                     ) -> Dict[str, jax.Array]:
+    """Zero-initialised paged ``{"k", "v"}`` pool (trash page included),
+    placed to its mesh sharding when one is given."""
+    k = jnp.zeros(spec.pool_shape, spec.dtype)
+    v = jnp.zeros(spec.pool_shape, spec.dtype)
+    if mesh is not None:
+        sh = paged_cache_shardings(spec, mesh)
+        k = jax.device_put(k, sh)
+        v = jax.device_put(v, sh)
+    return {"k": k, "v": v}
+
+
+class PageAllocatorError(RuntimeError):
+    """An allocator invariant broke (refcount underflow, double free) —
+    a HOST bug, raised loudly rather than silently corrupting the
+    slot→page mapping the compiled programs trust."""
+
+
+class PageAllocator:
+    """Host-side page bookkeeping for one paged serve run.
+
+    Owns the slot→page table (``table``, int32 ``(slots,
+    max_pages_per_slot)``, -1 = unmapped) and the free list. Pages are
+    REFCOUNTED: private pages hold refcount 1 (their slot); shared
+    prefix pages hold one count per using slot PLUS one registry hold
+    (``register_shared``) so the cached prefix survives every slot
+    freeing. All methods are deterministic (free list is FIFO in page
+    order) so a seeded serve run admits the same pages every run.
+    """
+
+    def __init__(self, spec: PagedCacheSpec):
+        self.spec = spec
+        self.free: List[int] = list(range(spec.pages))
+        self.refcount = np.zeros((spec.pages,), np.int64)
+        self.table = np.full(
+            (spec.slots, spec.max_pages_per_slot), -1, np.int32)
+        # shared prefix registry: logical page index -> page id, plus
+        # how many leading POSITIONS those full pages cover
+        self.shared_pages: Tuple[int, ...] = ()
+        self.shared_len = 0
+
+    # ------------------------------------------------------- internal
+
+    def _take(self) -> Optional[int]:
+        if not self.free:
+            return None
+        pg = self.free.pop(0)
+        self.refcount[pg] += 1
+        return pg
+
+    def _drop(self, pg: int) -> None:
+        if self.refcount[pg] <= 0:
+            raise PageAllocatorError(
+                f"page {pg} refcount underflow: freed more times than "
+                f"held — the slot→page bookkeeping is corrupt")
+        self.refcount[pg] -= 1
+        if self.refcount[pg] == 0:
+            self.free.append(pg)
+
+    # --------------------------------------------------------- shared
+
+    def register_shared(self, prefix_len: int) -> Tuple[int, ...]:
+        """Reserve the FULL pages of a ``prefix_len``-token shared
+        prefix (the partial tail page is never shared — admission forks
+        it into the slot's first private page by recomputation). Each
+        reserved page takes a registry hold so it survives all slots
+        freeing. Returns the reserved page ids, in logical order."""
+        if self.shared_pages:
+            raise PageAllocatorError("shared prefix already registered")
+        pt = self.spec.page_tokens
+        n_full = max(int(prefix_len), 0) // pt
+        pages: List[int] = []
+        for _ in range(n_full):
+            pg = self._take()
+            if pg is None:
+                for p in pages:        # rollback: nothing half-shared
+                    self._drop(p)
+                raise PageAllocatorError(
+                    f"pool of {self.spec.pages} pages cannot hold the "
+                    f"{n_full}-page shared prefix")
+            pages.append(pg)
+        self.shared_pages = tuple(pages)
+        self.shared_len = n_full * pt
+        return self.shared_pages
+
+    # ------------------------------------------------------ lifecycle
+
+    def admit(self, slot: int, prompt_len: int,
+              shared: bool = False) -> bool:
+        """Map pages for one admission: shared full prefix pages (when
+        ``shared``) plus private pages covering positions
+        ``[shared_len, prompt_len)``. All-or-nothing — a pool too empty
+        rolls back and returns False (the request stays WAITING, it is
+        not shed: admission denial by page exhaustion is backpressure,
+        not overload shedding)."""
+        if (self.table[slot] >= 0).any():
+            raise PageAllocatorError(
+                f"slot {slot} admitted while still holding pages")
+        pt = self.spec.page_tokens
+        need = -(-int(prompt_len) // pt)            # pages [0, need)
+        row = np.full((self.spec.max_pages_per_slot,), -1, np.int32)
+        taken: List[int] = []
+        for j in range(need):
+            if shared and j < len(self.shared_pages):
+                pg = self.shared_pages[j]
+                self.refcount[pg] += 1              # one hold per slot
+            else:
+                got = self._take()
+                if got is None:
+                    for p in taken:
+                        self._drop(p)
+                    if shared:
+                        for jj in range(min(j, len(self.shared_pages))):
+                            self._drop(self.shared_pages[jj])
+                    return False
+                pg = got
+                taken.append(pg)
+            row[j] = pg
+        self.table[slot] = row
+        return True
+
+    def admit_shared_len(self, shared: bool) -> int:
+        """The prefill's ``shared_len`` traced scalar for an admission:
+        positions below it are NOT written (their pages are the shared
+        prefix, already holding bitwise-identical content)."""
+        return self.shared_len if shared else 0
+
+    def ensure(self, slot: int, last_pos: int) -> bool:
+        """Grow a live slot's mapping to cover positions up to
+        ``last_pos`` (inclusive, clamped to the cache capacity) before
+        a dispatch writes them. All-or-nothing like :meth:`admit`."""
+        pt = self.spec.page_tokens
+        upto = min(int(last_pos), self.spec.max_seq - 1) // pt
+        taken: List[Tuple[int, int]] = []
+        for j in range(upto + 1):
+            if self.table[slot, j] >= 0:
+                continue
+            pg = self._take()
+            if pg is None:
+                for jj, p in taken:
+                    self._drop(p)
+                    self.table[slot, jj] = -1
+                return False
+            taken.append((j, pg))
+            self.table[slot, j] = pg
+        return True
+
+    def free_slot(self, slot: int) -> None:
+        """Return a finished/evicted slot's pages. Shared prefix pages
+        drop ONE count (the registry hold keeps them cached for the
+        next admission); private pages return to the free list."""
+        for j in range(self.spec.max_pages_per_slot):
+            pg = int(self.table[slot, j])
+            if pg >= 0:
+                self._drop(pg)
+            self.table[slot, j] = -1
+
+    # ------------------------------------------------------- queries
+
+    def row(self, slot: int) -> np.ndarray:
+        return self.table[slot].copy()
+
+    def pages_used(self) -> int:
+        return self.spec.pages - len(self.free)
+
+    def can_ever_admit(self, prompt_len: int, shared: bool) -> bool:
+        """Could this admission EVER succeed, even with every slot
+        freed? False means the request is structurally unservable at
+        this pool size (reject it — waiting forever would wedge the
+        run); the shared-prefix registry holds are the only permanent
+        reservation."""
+        pt = self.spec.page_tokens
+        need = -(-int(prompt_len) // pt)
+        if shared:
+            need = max(need - len(self.shared_pages), 0)
+        return need <= self.spec.pages - len(self.shared_pages)

@@ -60,9 +60,21 @@ class Request:
     max_new: int
 
 
+def shared_prefix_tokens(prefix_len: int, vocab_size: int,
+                         seed: int) -> np.ndarray:
+    """The run's shared system-prompt prefix: ``prefix_len`` seeded
+    tokens every ``--shared-prefix`` request starts with. One function,
+    used by both the request generator and the engine's prefix
+    registration, so the two can never disagree about the bytes."""
+    rng = np.random.default_rng([int(seed), 17])
+    return rng.integers(0, vocab_size,
+                        size=(int(prefix_len),)).astype(np.int32)
+
+
 def make_requests(n: int, *, prompt_pad: int, vocab_size: int,
                   max_new: int, rate: float, seed: int,
-                  prompt_min: int = 0) -> List[Request]:
+                  prompt_min: int = 0,
+                  prefix_len: int = 0) -> List[Request]:
     """Seeded synthetic request stream.
 
     Arrivals: Poisson process at ``rate`` requests/s (exponential
@@ -70,19 +82,31 @@ def make_requests(n: int, *, prompt_pad: int, vocab_size: int,
     t=0 — the closed-loop mode benchmarks and probes use. Prompts reuse
     the training data's deterministic next-token structure (the affine
     map of data.make_synthetic_tokens) with per-request lengths drawn
-    from [prompt_min, prompt_pad]."""
+    from [prompt_min, prompt_pad]. ``prefix_len > 0`` gives every
+    request the same :func:`shared_prefix_tokens` system-prompt prefix
+    (per-request tails stay distinct; prompt lengths never undercut the
+    prefix) — the paged engine's shared-prefix workload. ``prefix_len
+    = 0`` is bit-for-bit the original stream (identical rng draws)."""
     rng = np.random.default_rng(seed)
     if rate > 0:
         arrivals = np.cumsum(rng.exponential(1.0 / rate, size=n))
     else:
         arrivals = np.zeros(n)
     prompt_min = min(max(1, prompt_min or prompt_pad // 2), prompt_pad)
+    if prefix_len > 0:
+        prefix_len = min(int(prefix_len), prompt_pad)
+        prompt_min = max(prompt_min, prefix_len)
     lens = rng.integers(prompt_min, prompt_pad + 1, size=n)
     first = rng.integers(0, vocab_size, size=(n, 1)).astype(np.int32)
     toks = np.empty((n, prompt_pad), np.int32)
     toks[:, :1] = first
     for t in range(1, prompt_pad):
         toks[:, t] = (toks[:, t - 1] * 7 + 3) % vocab_size
+    if prefix_len > 0:
+        # overwrite the head with the shared prefix; the tail keeps
+        # each request's own chain (seeded from its own first token)
+        toks[:, :prefix_len] = shared_prefix_tokens(
+            prefix_len, vocab_size, seed)[None, :]
     out = []
     for i in range(n):
         padded = toks[i].copy()
@@ -90,6 +114,28 @@ def make_requests(n: int, *, prompt_pad: int, vocab_size: int,
         out.append(Request(rid=i, arrival_s=float(arrivals[i]),
                            tokens=padded, prompt_len=int(lens[i]),
                            max_new=int(max_new)))
+    return out
+
+
+def ngram_draft(history, k: int) -> List[int]:
+    """The cheap host-side draft proposer for speculative decoding:
+    repeat what followed the last occurrence of the current token in
+    the sequence so far (classic n-gram lookup, n=1), falling back to
+    repeating the token itself. Deterministic and model-free — the
+    verify forward accepts exactly the prefix of the draft that matches
+    the target's own greedy choices, so a bad draft costs nothing but
+    its acceptance rate."""
+    h = [int(t) for t in history]
+    out: List[int] = []
+    for _ in range(int(k)):
+        last = h[-1]
+        nxt = last
+        for i in range(len(h) - 2, -1, -1):
+            if h[i] == last:
+                nxt = h[i + 1]
+                break
+        out.append(nxt)
+        h.append(nxt)
     return out
 
 
@@ -175,7 +221,9 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
               resilience: Optional[res_lib.ResilienceConfig] = None,
               chaos: Any = None,
               virtual: Optional[res_lib.VirtualTiming] = None,
-              flush_events: Optional[bool] = None) -> Dict[str, Any]:
+              flush_events: Optional[bool] = None,
+              shared_prefix: Optional[np.ndarray] = None
+              ) -> Dict[str, Any]:
     """Drive the engine over the request stream; returns the run summary
     (percentiles, throughput, per-gate SLO statuses, the exact shed
     partition, compile counts).
@@ -198,7 +246,25 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
     chaos dispatch hook and on the tick cadence — so a kill cannot eat
     the evidence the resumed attempt classifies from (default: on when
     chaos or resilience is armed; the CLI also arms it under the
-    launcher's requeue supervision)."""
+    launcher's requeue supervision).
+
+    A PAGED engine (``engine.paged``) changes admission and dispatch,
+    never the accounting: slot admission additionally asks the page
+    allocator (a pool too full leaves the request WAITING —
+    backpressure, not shedding — while a request too big to EVER fit
+    this pool is ``rejected`` with reason ``kv_pages_exhausted``, in
+    the same exact ledger partition); each dispatch first grows every
+    live slot's page mapping to cover the positions it will write
+    (growth failure evicts, freeing the pages); finishing a slot
+    returns its pages. ``shared_prefix`` (token array) registers a
+    refcounted shared system-prompt prefix once, served from the same
+    pages to every admission that starts with it. With
+    ``engine.speculate_k >= 2`` decode dispatches become draft+verify:
+    the host :func:`ngram_draft` proposes, the engine's ONE batched
+    verify forward accepts — greedy output stays bitwise identical to
+    plain decode, only the tokens-per-dispatch changes. Speculation
+    runs at adapt level 0 only (the degradation ladder's rungs are
+    plain decode programs)."""
     import jax
     if n_chips is None:
         n_chips = max(jax.device_count(), 1)
@@ -221,11 +287,34 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
     waiting: deque = deque()         # accepted, not yet slotted
     slots: List[Optional[_Slot]] = [None] * engine.slots
     state = engine.init_state()
+    paged = bool(getattr(engine, "paged", False))
+    alloc = engine.new_allocator() if paged else None
+    prefix_len = 0
+    prefix_arr: Optional[np.ndarray] = None
+    if paged and shared_prefix is not None and len(shared_prefix) > 0:
+        prefix_arr = np.asarray(shared_prefix, np.int32)
+        prefix_len = int(min(len(prefix_arr), engine.prompt_pad))
+        prefix_arr = prefix_arr[:prefix_len]
+        # fills the prefix's full pages via the ONE prefill program;
+        # a pool that cannot hold the prefix is a config error, raised
+        state = engine.register_prefix(params, state, prefix_arr,
+                                       prefix_len)
+    spec_k = int(getattr(engine, "speculate_k", 0))
     results: Dict[int, Dict[str, Any]] = {}
     generated = truncated = dispatches = 0
+    drafted = accepted = 0          # speculative-draft acceptance
+    active_peak = pages_peak = 0
     queue_depths: List[int] = []
     recent_tok: deque = deque(maxlen=max(res.window, 1))
     t0 = clock()
+
+    def is_shared(req: Request) -> bool:
+        # a request rides the shared prefix iff its prompt literally
+        # starts with it — byte-checked, never assumed
+        return (prefix_arr is not None
+                and req.prompt_len >= prefix_len
+                and np.array_equal(np.asarray(req.tokens)[:prefix_len],
+                                   prefix_arr))
 
     def now() -> float:
         return clock() - t0
@@ -258,6 +347,12 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         event(s.req.rid, res_lib.DONE if why == "done" else
               res_lib.EVICTED, generated=s.generated)
         slots[i] = None
+        if paged:
+            # pages return to the pool (shared prefix pages drop one
+            # refcount; the registry hold keeps them cached). Safe: the
+            # device slot is frozen (budget/capacity) or masked out of
+            # every future dispatch until its next prefill
+            alloc.free_slot(i)
 
     def expire(t: float) -> None:
         # the accepted queue's head is always the oldest (FIFO in
@@ -309,6 +404,26 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         for i in range(engine.slots):
             if slots[i] is not None or not waiting:
                 continue
+            shared = False
+            if paged:
+                # peek-then-pop: a denied admission must leave the
+                # request at the queue head, not shed it
+                req = waiting[0]
+                shared = is_shared(req)
+                if not alloc.can_ever_admit(req.prompt_len, shared):
+                    # structurally unservable at this pool size: even
+                    # an empty pool could not hold the prompt. Reject
+                    # (exact-partition bucket) instead of wedging the
+                    # queue head forever
+                    waiting.popleft()
+                    led.rejected += 1
+                    event(req.rid, res_lib.REJECTED,
+                          reason="kv_pages_exhausted")
+                    continue
+                if not alloc.admit(i, req.prompt_len, shared=shared):
+                    # pool full RIGHT NOW: backpressure, not shedding —
+                    # running slots will finish and free pages
+                    break
             req = waiting.popleft()
             budget = req.max_new
             if cur_level > 0 and res.max_new_cap:
@@ -317,9 +432,15 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                 pass   # the admission decision itself is host-trivial
             with tracer.span("prefill", cat="serve", rid=req.rid,
                              slot=i, prompt_len=req.prompt_len):
-                state, first = engine.prefill(
-                    params, state, req.tokens[None, :], req.prompt_len,
-                    i, budget)
+                if paged:
+                    state, first = engine.prefill(
+                        params, state, req.tokens[None, :],
+                        req.prompt_len, i, budget,
+                        shared_len=alloc.admit_shared_len(shared))
+                else:
+                    state, first = engine.prefill(
+                        params, state, req.tokens[None, :],
+                        req.prompt_len, i, budget)
                 first = int(first)           # fence: the token exists NOW
             if virtual is not None:
                 virtual.clock.advance(virtual.prefill_s)
@@ -372,6 +493,25 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         # a sparse schedule would drown the mean in idle-gap zeros and
         # grow the sample list unboundedly)
         queue_depths.append(len(waiting))
+        # speculation only at full service: the degradation ladder's
+        # rungs are plain decode programs, and a downshifted pod wants
+        # its smallest dispatch, not a wider verify window
+        spec_on = paged and spec_k >= 2 and cur_level == 0
+        if paged:
+            # grow each live slot's mapping to cover every position
+            # this dispatch can write; a slot the pool cannot grow for
+            # is evicted (truncated output, pages fund the others)
+            width = spec_k if spec_on else cur_k
+            for i in occupied:
+                s = slots[i]
+                last = min(s.req.prompt_len + s.generated + width - 2,
+                           engine.max_seq - 1)
+                if not alloc.ensure(i, last):
+                    finish(i, "evicted")
+            occupied = [i for i in range(engine.slots)
+                        if slots[i] is not None]
+            if not occupied:
+                continue
         # the chaos serve surface: serve_kill dies HERE (a dispatch
         # boundary — the compiled program is never torn mid-flight),
         # serve_slow returns the stall it injected so virtual time can
@@ -384,19 +524,54 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                 metrics.flush()
             stall_s = float(chaos.on_serve_dispatch(dispatches) or 0.0)
         t_dispatch = clock()
-        with tracer.span("decode_step", cat="serve",
-                         active=len(occupied), decode_k=cur_k):
-            state, toks, valid = engine.decode(params, state, cur_k)
-            toks = np.asarray(toks)          # fence: tokens on host
-            valid = np.asarray(valid)
+        if spec_on:
+            occ_mask = np.array([s is not None for s in slots])
+            draft = np.zeros((engine.slots, spec_k - 1), np.int32)
+            for i in occupied:
+                s = slots[i]
+                draft[i] = ngram_draft(
+                    list(s.req.tokens[:s.req.prompt_len]) + s.output,
+                    spec_k - 1)
+            with tracer.span("verify_step", cat="serve",
+                             active=len(occupied), window=spec_k):
+                state, toks, valid, _emitted = engine.verify(
+                    params, state, draft, dispatch_active=occ_mask)
+                toks = np.asarray(toks)      # fence: tokens on host
+                valid = np.asarray(valid)
+        elif paged:
+            occ_mask = np.array([s is not None for s in slots])
+            with tracer.span("decode_step", cat="serve",
+                             active=len(occupied), decode_k=cur_k):
+                state, toks, valid = engine.decode(
+                    params, state, cur_k, dispatch_active=occ_mask)
+                toks = np.asarray(toks)      # fence: tokens on host
+                valid = np.asarray(valid)
+        else:
+            with tracer.span("decode_step", cat="serve",
+                             active=len(occupied), decode_k=cur_k):
+                state, toks, valid = engine.decode(params, state, cur_k)
+                toks = np.asarray(toks)      # fence: tokens on host
+                valid = np.asarray(valid)
         if virtual is not None:
             dt = virtual.decode_s + stall_s
             virtual.clock.advance(dt)
         else:
             dt = (clock() - t_dispatch) + stall_s
         dispatches += 1
-        per_tok = dt / cur_k
-        recent_tok.append(per_tok)
+        active_peak = max(active_peak, len(occupied))
+        if paged:
+            pages_peak = max(pages_peak, alloc.pages_used())
+        if spec_on:
+            # a verify dispatch emits a VARIABLE token count per slot:
+            # ITL attributes the dispatch wall over each slot's own
+            # accepted run (that is speculation's whole win)
+            tot_new = int(valid.sum())
+            mean_new = tot_new / max(len(occupied), 1)
+            recent_tok.append(dt / mean_new if mean_new > 0 else dt)
+            per_tok = None
+        else:
+            per_tok = dt / cur_k
+            recent_tok.append(per_tok)
         for i in occupied:
             col_valid = valid[:, i]
             n_new = int(col_valid.sum())
@@ -405,7 +580,11 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                     int(t) for t in toks[col_valid, i])
                 slots[i].generated += n_new
                 generated += n_new
-                stats.note_itl(per_tok, n_new)
+                stats.note_itl(dt / n_new if spec_on else per_tok,
+                               n_new)
+                if spec_on:
+                    accepted += n_new - 1    # minus the bonus token
+                    drafted += spec_k - 1
             s = slots[i]
             if s.generated >= s.budget:
                 finish(i, "done")
@@ -447,6 +626,16 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                     metrics.flush()
         if metrics is not None:
             wall = now()
+            extra: Dict[str, Any] = {}
+            if paged:
+                # the PAGED footprint — what is actually allocated
+                # (pool + table), not the dense slots×max_seq formula
+                extra = {"kv_pages_used": alloc.pages_used(),
+                         "kv_pages_total": engine.spec.pages,
+                         "kv_cache_bytes": engine.spec.bytes,
+                         "spec_accept_rate": (
+                             round(accepted / drafted, 4)
+                             if drafted else None)}
             metrics.log(kind="serve_tick", t_s=round(wall, 4),
                         queue_depth=len(waiting),
                         active_slots=sum(s is not None for s in slots),
@@ -460,7 +649,8 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                         itl_p99_s=summ["itl_p99_s"],
                         tokens_per_sec_per_chip=(
                             round(generated / wall / n_chips, 3)
-                            if wall > 0 else None))
+                            if wall > 0 else None),
+                        **extra)
 
     wall_s = now()
     # an empty run measured NOTHING: throughput is None (→ the gate
@@ -508,6 +698,15 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         "alert_events": alerts.events,
         "prefill_compiles": engine.compile_counts()[0],
         "decode_compiles": engine.compile_counts()[1],
+        "verify_compiles": len(getattr(engine, "verify_traces", [])),
+        "active_slots_peak": active_peak,
+        "kv_page_tokens": (engine.spec.page_tokens if paged else 0),
+        "kv_pages_total": (engine.spec.pages if paged else 0),
+        "kv_pages_used_peak": pages_peak,
+        "spec_accept_rate": (round(accepted / drafted, 4)
+                             if drafted else None),
+        "speculate_k": spec_k,
+        "shared_prefix_len": prefix_len,
         "results": results,
         "thresholds": {rule: rules_lib.resolve(rule)
                        for rule, _ in slo_lib.SERVE_RULES},

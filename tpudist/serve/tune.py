@@ -37,6 +37,12 @@ from tpudist.tune import search as search_mod
 # knee is what matters, not every integer. Capped where per-dispatch
 # latency starts to dominate ITL attribution (slo: ITL = wall / k).
 DECODE_K_LADDER = (1, 2, 4, 8, 16, 32)
+# Paged-axis ladders (serve mode only): page sizes worth probing (0 —
+# the dense arena — is always the walk's committed fallback) and verify
+# window widths (window includes the pending last token, so 2 is the
+# smallest real speculation).
+KV_PAGE_TOKENS_LADDER = (8, 16, 32)
+SPECULATE_K_LADDER = (2, 4, 8)
 
 DEFAULT_PROBE_DISPATCHES = 8
 DEFAULT_PROBE_REPEATS = 3
@@ -45,10 +51,15 @@ DEFAULT_TRIALS = 8
 
 @dataclasses.dataclass(frozen=True)
 class ServeCandidate:
-    """One point in the serve knob space."""
+    """One point in the serve knob space. ``kv_page_tokens = 0`` is the
+    dense arena; > 0 selects the paged engine at that page size.
+    ``speculate_k = 0`` is plain decode; >= 2 is the draft+verify
+    window (meaningful only with paging — the walk gates it so)."""
 
     decode_k: int = 8
     layout: str = "st"
+    kv_page_tokens: int = 0
+    speculate_k: int = 0
 
     def replace(self, **kw) -> "ServeCandidate":
         return dataclasses.replace(self, **kw)
@@ -59,10 +70,18 @@ class ServeCandidate:
 
 def validate_serve_tuned(tuned: Dict[str, Any]) -> bool:
     """Knob sanity for a cached serve record (the ``validate`` hook of
-    :func:`tpudist.tune.cache.load`): an insane decode_k or unknown
-    layout is a cache MISS (re-probe), never a crash in the engine."""
+    :func:`tpudist.tune.cache.load`): an insane decode_k, unknown
+    layout, or a pre-paging record missing the paged knobs is a cache
+    MISS (re-probe), never a crash in the engine."""
+    if "kv_page_tokens" not in tuned or "speculate_k" not in tuned:
+        return False              # pre-paging schema: re-probe
     if int(tuned["decode_k"]) < 1:
         return False
+    pt, sk = int(tuned["kv_page_tokens"]), int(tuned["speculate_k"])
+    if pt < 0 or sk < 0 or sk == 1:
+        return False
+    if sk >= 2 and pt == 0:
+        return False              # speculation needs the paged engine
     return tuned["layout"] in KV_CACHE_LAYOUTS
 
 
@@ -88,6 +107,10 @@ def fingerprint(model_cfg, mesh, *, slots: int, max_seq: int,
     payload = {
         "schema": cache_mod.SCHEMA,
         "what": "serve",
+        # knob-space generation: bumped when the candidate schema grows
+        # (paged knobs joined at 2) so records from an older walk never
+        # alias a fingerprint whose search space they never saw
+        "knobs": 2,
         "model": dataclasses.asdict(model_cfg),
         "slots": int(slots),
         "max_seq": int(max_seq),
@@ -124,57 +147,100 @@ def probe_candidate(model_cfg, mesh, params, cand: ServeCandidate, *,
     """Measure one candidate: build its engine, prefill every slot, time
     ``repeats`` runs of ``n_dispatches`` decode supersteps at full
     occupancy. Estimator over repeats is the MIN elapsed (one-sided host
-    noise, same reasoning as tune.probe). Never raises — any failure
-    (OOM, bad layout lowering) is a pruned ``feasible=False`` result."""
+    noise, same reasoning as tune.probe). A paged candidate probes the
+    paged engine (default full-capacity pool: the probe measures the
+    program, not an artificial page famine); a speculative one times
+    draft+verify dispatches and counts the tokens the verifies actually
+    emitted — fenced ``lengths`` deltas, not ``k × dispatches``, since
+    acceptance is workload-dependent and crediting rejected drafts
+    would let speculation look free. Never raises — any failure (OOM,
+    bad layout lowering) is a pruned ``feasible=False`` result."""
     import jax
     import numpy as np
 
-    from tpudist.serve.engine import ServeEngine
+    from tpudist.serve.engine import PagedServeEngine, ServeEngine
     try:
-        engine = ServeEngine(model_cfg, mesh, slots=slots,
-                             max_seq=max_seq, prompt_pad=prompt_pad,
-                             decode_k=cand.decode_k, layout=cand.layout)
+        paged = cand.kv_page_tokens > 0
+        spec_k = cand.speculate_k if paged else 0
+        if paged:
+            engine = PagedServeEngine(
+                model_cfg, mesh, slots=slots, max_seq=max_seq,
+                prompt_pad=prompt_pad, decode_k=cand.decode_k,
+                page_tokens=cand.kv_page_tokens, speculate_k=spec_k)
+        else:
+            engine = ServeEngine(model_cfg, mesh, slots=slots,
+                                 max_seq=max_seq, prompt_pad=prompt_pad,
+                                 decode_k=cand.decode_k,
+                                 layout=cand.layout)
         # per-slot decode budget must cover every timed dispatch so the
         # whole probe runs at full occupancy (an emptying batch would
         # flatter small decode_k); shrink the dispatch count if the
         # cache pages cannot hold that many tokens
-        room = (max_seq - prompt_pad - 1) // cand.decode_k
+        width = spec_k if spec_k >= 2 else cand.decode_k
+        room = (max_seq - prompt_pad - 1) // width
         n_disp = max(1, min(int(n_dispatches), room))
-        budget = n_disp * cand.decode_k + 2
+        budget = n_disp * width + 2
         prompt = np.arange(prompt_pad, dtype=np.int32) \
             % model_cfg.vocab_size
 
         def fill() -> Any:
             state = engine.init_state()
+            if paged:
+                engine.new_allocator()
+            outs = []
             for s in range(slots):
-                state, _ = engine.prefill(params, state, prompt[None, :],
-                                          prompt_pad, s, budget)
-            return state
+                if paged:
+                    engine.alloc.admit(s, prompt_pad)  # full-capacity
+                    # pool: cannot fail at probe shapes
+                state, first = engine.prefill(
+                    params, state, prompt[None, :], prompt_pad, s,
+                    budget)
+                outs.append([int(x) for x in prompt] + [int(first)])
+            if paged:
+                # map every page up front: the probe times dispatch
+                # compute, not incremental host allocation
+                for s in range(slots):
+                    engine.alloc.ensure(s, max_seq - 1)
+            return state, outs
 
-        # warm: compile both programs off the timed path
-        state = fill()
-        state, toks, _ = engine.decode(params, state)
+        def dispatch(state, outs):
+            if spec_k >= 2:
+                from tpudist.serve.scheduler import ngram_draft
+                draft = np.zeros((slots, spec_k - 1), np.int32)
+                for s in range(slots):
+                    draft[s] = ngram_draft(outs[s], spec_k - 1)
+                state, toks, valid, _ = engine.verify(params, state,
+                                                      draft)
+                tv, vv = np.asarray(toks), np.asarray(valid)  # fence —
+                # the NEXT draft needs these tokens; part of the cost
+                for s in range(slots):
+                    outs[s].extend(int(x) for x in tv[vv[:, s], s])
+                return state, toks
+            state, toks, _ = engine.decode(params, state)
+            return state, toks
+
+        # warm: compile every program off the timed path
+        state, outs = fill()
+        state, toks = dispatch(state, outs)
         np.asarray(toks)
         times: List[float] = []
+        tokens = 0
         for _ in range(repeats):
-            state = fill()
-            jax.device_get(state.lengths)    # admissions fenced
+            state, outs = fill()
+            len0 = int(np.asarray(state.lengths).sum())  # fence too
             t0 = time.perf_counter()
             toks = None
             for _ in range(n_disp):
-                state, toks, _ = engine.decode(params, state)
+                state, toks = dispatch(state, outs)
             np.asarray(toks)                 # fence on the tokens
             times.append(time.perf_counter() - t0)
+            # honest token count from the device's own ledger: every
+            # emitted token advanced a slot's length by exactly one, a
+            # frozen slot's by zero — so an oversized decode_k or a
+            # rejected draft can never inflate the estimate
+            tokens = int(np.asarray(state.lengths).sum()) - len0
         best = min(times)
         spread = (max(times) - best) / best if best > 0 else 0.0
-        # honest token count: a slot freezes once its cache page fills
-        # (at max_seq), so an oversized decode_k (start candidates are
-        # not ladder-capped) generates fewer tokens than k×dispatches —
-        # crediting the frozen tail would inflate the start's baseline
-        # and let the never-slower-than-start floor reject genuinely
-        # faster points
-        per_slot = min(n_disp * cand.decode_k, max_seq - prompt_pad)
-        tokens = slots * per_slot
         return ServeProbeResult(
             tokens_per_sec=tokens / best if best > 0 else 0.0,
             dispatch_ms=best * 1000.0 / n_disp, spread=spread,
@@ -201,13 +267,19 @@ class ServeTuneOutcome:
 
 
 def _search(measure, start: ServeCandidate, *, max_decode_k: int,
-            trial_budget: int) -> Dict[str, Any]:
-    """Deterministic two-axis walk sharing the train search's
-    discipline: decode_k first (ordered ascent, regress early-stop,
+            trial_budget: int,
+            max_page_tokens: int = 0) -> Dict[str, Any]:
+    """Deterministic axis walk sharing the train search's discipline:
+    decode_k first (ordered ascent, regress early-stop,
     plateau-prefers-smallest within PLATEAU_TOL — shorter supersteps
     mean honester ITL at indistinguishable throughput), then layout at
-    the committed decode_k (best wins; ties keep the start's layout).
-    The committed point NEVER measures slower than the start."""
+    the committed decode_k (best wins; ties keep the start's layout),
+    then the paged axes: ``kv_page_tokens`` (a real win over the
+    committed point switches storage discipline; a tie keeps it — the
+    dense arena is the simpler program) and, only at a committed page
+    size, ``speculate_k`` (same real-win bar: acceptance-rate-dependent
+    speedups must MEASURE, never be assumed). The committed point NEVER
+    measures slower than the start."""
     memo: Dict[ServeCandidate, ServeProbeResult] = {}
     out = {"best": start, "best_tps": 0.0, "baseline_tps": 0.0,
            "trials": 0, "pruned": 0}
@@ -269,6 +341,34 @@ def _search(measure, start: ServeCandidate, *, max_decode_k: int,
             out["best"] = out["best"].replace(layout=layout)
             out["best_tps"] = res.tokens_per_sec
 
+    # ---- paged axes (serve-mode coordinates, PR 16) ----
+    if max_page_tokens > 0:
+        for pt in KV_PAGE_TOKENS_LADDER:
+            if pt > max_page_tokens \
+                    or pt == out["best"].kv_page_tokens:
+                continue
+            # page size probes without speculation: one axis at a time
+            res = run(out["best"].replace(kv_page_tokens=pt,
+                                          speculate_k=0))
+            if res is None or not res.feasible:
+                continue
+            if res.tokens_per_sec > out["best_tps"] * (
+                    1 + search_mod.PLATEAU_TOL):
+                out["best"] = out["best"].replace(kv_page_tokens=pt,
+                                                  speculate_k=0)
+                out["best_tps"] = res.tokens_per_sec
+        if out["best"].kv_page_tokens > 0:
+            for sk in SPECULATE_K_LADDER:
+                if sk == out["best"].speculate_k:
+                    continue
+                res = run(out["best"].replace(speculate_k=sk))
+                if res is None or not res.feasible:
+                    continue
+                if res.tokens_per_sec > out["best_tps"] * (
+                        1 + search_mod.PLATEAU_TOL):
+                    out["best"] = out["best"].replace(speculate_k=sk)
+                    out["best_tps"] = res.tokens_per_sec
+
     # the hard floor: never commit a point slower than the measured start
     if out["best"] != start and out["best_tps"] < out["baseline_tps"]:
         out["best"], out["best_tps"] = start, out["baseline_tps"]
@@ -303,7 +403,9 @@ def autotune_serve(model_cfg, mesh, params, *, slots: int, max_seq: int,
     if rec is not None:
         t = rec["tuned"]
         tuned = ServeCandidate(decode_k=int(t["decode_k"]),
-                               layout=t["layout"])
+                               layout=t["layout"],
+                               kv_page_tokens=int(t["kv_page_tokens"]),
+                               speculate_k=int(t["speculate_k"]))
         if tuned.decode_k <= max_seq - prompt_pad:
             return _log(ServeTuneOutcome(
                 tuned=tuned, source="cache",
@@ -330,7 +432,8 @@ def autotune_serve(model_cfg, mesh, params, *, slots: int, max_seq: int,
     try:
         out = _search(measure, start,
                       max_decode_k=max(1, max_seq - prompt_pad - 1),
-                      trial_budget=trials)
+                      trial_budget=trials,
+                      max_page_tokens=max_seq)
     except Exception as e:
         from tpudist.metrics import log0
         log0(f"tpudist: serve autotune probing failed ({e!r}); "
@@ -364,6 +467,8 @@ def _log(out: ServeTuneOutcome, metrics: Any) -> ServeTuneOutcome:
                     source=out.source, trials=out.trials,
                     pruned=out.pruned, fingerprint=out.fingerprint,
                     decode_k=out.tuned.decode_k, layout=out.tuned.layout,
+                    kv_page_tokens=out.tuned.kv_page_tokens,
+                    speculate_k=out.tuned.speculate_k,
                     tokens_per_sec=out.tokens_per_sec,
                     baseline_tokens_per_sec=out.baseline_tokens_per_sec)
     return out
