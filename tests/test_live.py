@@ -59,14 +59,16 @@ def test_rules_table_names_and_alert_subset():
     assert names == {"straggler", "staging", "comm", "comm_dcn",
                      "regress", "stall", "trace_drop", "ttft", "itl",
                      "tokens_per_chip", "serve_shed", "spec_accept",
-                     "goodput"}
-    # every rule but the artifact-quality one, the DCN threshold row,
+                     "flight_decomp", "goodput"}
+    # every rule but the artifact-quality ones, the DCN threshold row,
     # and the off-by-default speculative-acceptance floor is a live
     # alert (comm_dcn is a per-fabric CEILING the comm alert
     # substitutes via resolve_comm, not its own (rule, host) key — the
-    # at-exit comm_status cross-check must find ONE matching alert)
+    # at-exit comm_status cross-check must find ONE matching alert;
+    # flight_decomp grades an at-exit artifact reconstruction, never a
+    # live stream)
     assert {t.name for t in rules_lib.ALERT_RULES} == names - {
-        "trace_drop", "comm_dcn", "spec_accept"}
+        "trace_drop", "comm_dcn", "spec_accept", "flight_decomp"}
 
 
 def test_rules_resolve_comm_fabric_dispatch(monkeypatch):
@@ -148,6 +150,10 @@ def test_exit_graders_share_the_rules_constants():
     assert devtime_lib.COMM_EXPOSED_MAX is rules_lib.COMM_EXPOSED_MAX
     assert report_lib.REGRESS_MIN_FRACTION is rules_lib.REGRESS_MIN_FRACTION
     assert config_lib.OBS_STALL_TIMEOUT_S is rules_lib.STALL_TIMEOUT_S
+    # the flight verifier resolves its tolerance from the same table
+    from tpudist.serve import flight as flight_lib
+    assert flight_lib.verify({})["ttft_decomp_tol_s"] \
+        == rules_lib.FLIGHT_DECOMP_TOL_S == rules_lib.resolve("flight_decomp")
 
 
 def test_exit_graders_honor_the_same_env_knobs(monkeypatch):
@@ -164,6 +170,12 @@ def test_exit_graders_honor_the_same_env_knobs(monkeypatch):
     # ratio 2x: clear under the 3.0 override in both consumers
     assert verdict_lib.straggler_status([0.1, 0.2]) == verdict_lib.SUCCESS
     assert not rules_lib.breached("straggler", 2.0)
+    # the flight-ledger tolerance rides the same env-at-call discipline
+    from tpudist.serve import flight as flight_lib
+    monkeypatch.setenv("TPUDIST_SERVE_FLIGHT_TOL_S", "0.25")
+    assert flight_lib.verify({})["ttft_decomp_tol_s"] == 0.25
+    assert rules_lib.breached("flight_decomp", 0.3)
+    assert not rules_lib.breached("flight_decomp", 0.2)
 
 
 # ----------------------------------------------------------- alert engine
@@ -665,6 +677,87 @@ def test_prometheus_text_golden():
     HELP/TYPE headers, label quoting, int-vs-float rendering, the
     fixed-label alert_firing series, None-valued series omitted."""
     assert live_lib.prometheus_text(SCRIPTED_STATUS) == GOLDEN_PROM
+
+
+SCRIPTED_SERVE_STATUS = {
+    "schema": 1, "run_id": "s1", "requeue_attempt": 0,
+    "pod": {"serve": {
+        "queue_depth": 3, "completed": 7, "generated_tokens": 50,
+        "ttft_p99_s": 0.02, "itl_p99_s": 0.004,
+        "tokens_per_sec_per_chip": 12.5, "shed_fraction": 0.25,
+        "kv_pages_used": 5, "kv_pages_total": 24, "kv_shared_refs": 2,
+        "spec_accept_rate": 0.8,
+        "ttft_hist": {"buckets": [0.01, 0.05], "counts": [2, 1, 1],
+                      "sum": 0.25, "count": 4},
+        "itl_hist": {"buckets": [0.005], "counts": [3, 0],
+                     "sum": 0.01, "count": 3}}},
+    "hosts": {}, "alerts": {"firing": []}, "counters": {},
+}
+
+GOLDEN_SERVE_PROM = """\
+# HELP tpudist_serve_queue_depth Requests waiting for a slot.
+# TYPE tpudist_serve_queue_depth gauge
+tpudist_serve_queue_depth 3
+# HELP tpudist_serve_completed_total Requests completed so far.
+# TYPE tpudist_serve_completed_total counter
+tpudist_serve_completed_total 7
+# HELP tpudist_serve_generated_tokens_total Tokens generated so far.
+# TYPE tpudist_serve_generated_tokens_total counter
+tpudist_serve_generated_tokens_total 50
+# HELP tpudist_serve_ttft_p99_seconds p99 time-to-first-token.
+# TYPE tpudist_serve_ttft_p99_seconds gauge
+tpudist_serve_ttft_p99_seconds 0.02
+# HELP tpudist_serve_itl_p99_seconds p99 inter-token latency.
+# TYPE tpudist_serve_itl_p99_seconds gauge
+tpudist_serve_itl_p99_seconds 0.004
+# HELP tpudist_serve_tokens_per_sec_per_chip Decode throughput per chip.
+# TYPE tpudist_serve_tokens_per_sec_per_chip gauge
+tpudist_serve_tokens_per_sec_per_chip 12.5
+# HELP tpudist_serve_shed_fraction Shed share of all arrivals (the \
+serve_shed gate's observable).
+# TYPE tpudist_serve_shed_fraction gauge
+tpudist_serve_shed_fraction 0.25
+# HELP tpudist_serve_kv_pages_used KV cache pages currently held \
+(slots + shared-prefix registry).
+# TYPE tpudist_serve_kv_pages_used gauge
+tpudist_serve_kv_pages_used 5
+# HELP tpudist_serve_kv_pages_total KV cache pool capacity in pages.
+# TYPE tpudist_serve_kv_pages_total gauge
+tpudist_serve_kv_pages_total 24
+# HELP tpudist_serve_kv_shared_refs Refcounts currently held on the \
+shared-prefix pages.
+# TYPE tpudist_serve_kv_shared_refs gauge
+tpudist_serve_kv_shared_refs 2
+# HELP tpudist_serve_spec_accept_rate Fraction of drafted tokens the \
+target model accepted.
+# TYPE tpudist_serve_spec_accept_rate gauge
+tpudist_serve_spec_accept_rate 0.8
+# HELP tpudist_serve_ttft_seconds Time-to-first-token distribution \
+(native histogram, fixed buckets).
+# TYPE tpudist_serve_ttft_seconds histogram
+tpudist_serve_ttft_seconds_bucket{le="0.01"} 2
+tpudist_serve_ttft_seconds_bucket{le="0.05"} 3
+tpudist_serve_ttft_seconds_bucket{le="+Inf"} 4
+tpudist_serve_ttft_seconds_sum 0.25
+tpudist_serve_ttft_seconds_count 4
+# HELP tpudist_serve_itl_seconds Inter-token latency distribution \
+(native histogram, fixed buckets).
+# TYPE tpudist_serve_itl_seconds histogram
+tpudist_serve_itl_seconds_bucket{le="0.005"} 3
+tpudist_serve_itl_seconds_bucket{le="+Inf"} 3
+tpudist_serve_itl_seconds_sum 0.01
+tpudist_serve_itl_seconds_count 3
+"""
+
+
+def test_prometheus_serve_golden():
+    """Serve-slice exposition golden: gauges + the two native histogram
+    families (per-bucket counts cumulated into le= rows, +Inf row equal
+    to _count, _sum/_count trailers) render exactly and in order."""
+    text = live_lib.prometheus_text(SCRIPTED_SERVE_STATUS)
+    start = text.index("# HELP tpudist_serve_queue_depth")
+    end = text.index("# HELP tpudist_alert_firing")
+    assert text[start:end] == GOLDEN_SERVE_PROM
 
 
 def test_prometheus_escaping_and_numbers():

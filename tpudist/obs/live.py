@@ -521,7 +521,8 @@ class LiveAggregator:
                       "tokens_per_sec_per_chip", "status",
                       "shed_total", "shed_fraction", "adapt_level",
                       "decode_k", "kv_pages_used", "kv_pages_total",
-                      "spec_accept_rate"):
+                      "kv_shared_refs", "spec_accept_rate",
+                      "ttft_hist", "itl_hist"):
                 if rec.get(k) is not None:
                     sv[k] = rec[k]
             step = sv.get("completed")
@@ -873,8 +874,14 @@ _PROM_HELP = {
     "tpudist_serve_kv_pages_used": "KV cache pages currently held "
                                    "(slots + shared-prefix registry).",
     "tpudist_serve_kv_pages_total": "KV cache pool capacity in pages.",
+    "tpudist_serve_kv_shared_refs": "Refcounts currently held on the "
+                                    "shared-prefix pages.",
     "tpudist_serve_spec_accept_rate": "Fraction of drafted tokens the "
                                       "target model accepted.",
+    "tpudist_serve_ttft_seconds": "Time-to-first-token distribution "
+                                  "(native histogram, fixed buckets).",
+    "tpudist_serve_itl_seconds": "Inter-token latency distribution "
+                                 "(native histogram, fixed buckets).",
     "tpudist_alert_firing": "1 while the named alert rule fires.",
     "tpudist_alerts_total": "Alert fire/resolve transitions so far.",
     "tpudist_records_total": "Telemetry records ingested.",
@@ -912,6 +919,29 @@ def prometheus_text(status: Dict[str, Any]) -> str:
                                for k, x in lbl.items())
             out.append(f"{name}{{{label_s}}} {_prom_num(v)}"
                        if label_s else f"{name} {_prom_num(v)}")
+
+    def hist(name: str, h: Any) -> None:
+        # a native histogram family from the self-describing hist
+        # record the serve loop ships on every tick (per-bucket counts
+        # + overflow bin; cumulated HERE into le= rows, the exposition
+        # format's convention). A malformed or absent record renders
+        # nothing — same None-skipping posture as metric()
+        if not isinstance(h, dict):
+            return
+        buckets, counts = h.get("buckets"), h.get("counts")
+        if (not isinstance(buckets, list) or not isinstance(counts, list)
+                or len(counts) != len(buckets) + 1):
+            return
+        out.append(f"# HELP {name} {_PROM_HELP[name]}")
+        out.append(f"# TYPE {name} histogram")
+        cum = 0
+        for ub, c in zip(buckets, counts):
+            cum += int(c)
+            out.append(f'{name}_bucket{{le="{_prom_num(ub)}"}} {cum}')
+        cum += int(counts[-1])
+        out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+        out.append(f"{name}_sum {_prom_num(h.get('sum', 0.0))}")
+        out.append(f"{name}_count {cum}")
 
     pod = status.get("pod", {})
     hosts = status.get("hosts", {})
@@ -978,8 +1008,12 @@ def prometheus_text(status: Dict[str, Any]) -> str:
            [({}, sv.get("kv_pages_used"))])
     metric("tpudist_serve_kv_pages_total",
            [({}, sv.get("kv_pages_total"))])
+    metric("tpudist_serve_kv_shared_refs",
+           [({}, sv.get("kv_shared_refs"))])
     metric("tpudist_serve_spec_accept_rate",
            [({}, sv.get("spec_accept_rate"))])
+    hist("tpudist_serve_ttft_seconds", sv.get("ttft_hist"))
+    hist("tpudist_serve_itl_seconds", sv.get("itl_hist"))
     # one series per alert RULE: 1 when any (rule, host) key fires —
     # a fixed label set scrapers can alert on without knowing hosts
     firing_rules = {a["alert"] for a in alerts.get("firing", [])}
@@ -1205,6 +1239,28 @@ def render_status(status: Dict[str, Any]) -> str:
                 f"  {h.get('phase') or '-':<10} "
                 f"{fmt(h.get('steps_per_sec')):>8}  "
                 f"{fmt(h.get('age_s'), '{:.1f}s'):>6}")
+    sv = pod.get("serve")
+    if sv:
+        # the serving pod's vitals, one row (plus KV/spec detail only
+        # when the paged plane reported it): a serve run tailed with
+        # this dashboard previously rendered as an idle TRAIN pod
+        line = (f"serve: {fmt(sv.get('tokens_per_sec_per_chip'))} "
+                f"tok/s/chip"
+                f" · queue {sv.get('queue_depth') if sv.get('queue_depth') is not None else '-'}"
+                f" · active {sv.get('active_slots') if sv.get('active_slots') is not None else '-'}"
+                f" · done {sv.get('completed') if sv.get('completed') is not None else '-'}"
+                f" · shed {fmt(sv.get('shed_fraction'), '{:.1%}')}"
+                f" · ttft p99 {fmt(sv.get('ttft_p99_s'), '{:.3f}s')}"
+                f" · itl p99 {fmt(sv.get('itl_p99_s'), '{:.3f}s')}")
+        if sv.get("kv_pages_total") is not None:
+            used = sv.get("kv_pages_used")
+            line += (f" · kv pages "
+                     f"{used if used is not None else '-'}"
+                     f"/{sv.get('kv_pages_total')}")
+        if sv.get("spec_accept_rate") is not None:
+            line += (f" · spec accept "
+                     f"{fmt(sv.get('spec_accept_rate'), '{:.1%}')}")
+        lines.append(line)
     firing = alerts.get("firing", [])
     if firing:
         lines.append("ALERTS FIRING:")

@@ -44,6 +44,7 @@ from tpudist import rules as rules_lib
 from tpudist.obs import devtime as devtime_mod
 from tpudist.obs import goodput as goodput_mod
 from tpudist.obs import live as live_mod
+from tpudist.serve import flight as flight_mod
 from tpudist.serve import slo as slo_mod
 
 # Schema 5: adds the "goodput" section (cross-attempt wall-clock
@@ -54,7 +55,15 @@ from tpudist.serve import slo as slo_mod
 # rejected/lost, shed_fraction + the serve_shed gate) and the
 # degradation ladder's adapt_level/adapt_transitions; the Alerts
 # cross-check adds the serve-gate table (rules.SERVE_STATUS_RULES).
-REPORT_SCHEMA_VERSION = 6
+# Schema 7: adds the "flights" section (per-request flight ledger from
+# tpudist.serve.flight — chain-exactness verdict, bitwise ShedLedger
+# reconciliation, TTFT decomposed into queue/prefill/decode components,
+# spec-acceptance trajectory, shed/evict timeline); the serving section
+# grows the PR 16 paged-footprint fields (kv_page_tokens /
+# kv_pages_total / kv_pages_used_peak / kv_shared_refs,
+# spec_accept_rate + the spec_accept gate, speculate_k,
+# shared_prefix_len, active_slots_peak, verify_compiles).
+REPORT_SCHEMA_VERSION = 7
 
 # Artifact schemas this reader KNOWS. A newer number is a warning, not
 # a failure: a requeue loop can scatter attempts across tpudist
@@ -661,8 +670,22 @@ def serving_section(metrics: List[Dict[str, Any]],
         "e2e_p99_s": s.get("e2e_p99_s"),
         "prefill_compiles": s.get("prefill_compiles"),
         "decode_compiles": s.get("decode_compiles"),
+        "verify_compiles": s.get("verify_compiles"),
         "queue_depth_max": s.get("queue_depth_max"),
         "queue_over_time": queue,
+        "active_slots_peak": s.get("active_slots_peak"),
+        # the PR 16 paged footprint + speculation fields: what the pool
+        # actually held at peak and how well the draft guessed. The
+        # spec_accept gate re-grades here like every other gate (env
+        # read at fold time); pre-paged artifacts read None/absent
+        "kv_page_tokens": s.get("kv_page_tokens"),
+        "kv_pages_total": s.get("kv_pages_total"),
+        "kv_pages_used_peak": s.get("kv_pages_used_peak"),
+        "spec_accept_rate": s.get("spec_accept_rate"),
+        "spec_accept_status": slo_mod.rule_status(
+            "spec_accept", s.get("spec_accept_rate")),
+        "speculate_k": s.get("speculate_k"),
+        "shared_prefix_len": s.get("shared_prefix_len"),
         # the resilience plane's exact shed partition (PR 15): absent
         # keys on pre-resilience artifacts simply read None
         "arrived": s.get("arrived"), "admitted": s.get("admitted"),
@@ -682,6 +705,51 @@ def serving_section(metrics: List[Dict[str, Any]],
                    if tunes else None),
         "baseline_tokens_per_sec_per_chip": base_tps,
         "tokens_per_chip_ratio": ratio,
+    }
+
+
+def flights_section(metrics: List[Dict[str, Any]],
+                    trace_doc: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Any]:
+    """The request-flight slice (tpudist.serve.flight): every arrived
+    rid reconstructed into its lifecycle chain and verified EXACTLY —
+    one admission verdict, one terminal state, TTFT equal to its own
+    queue/prefill decomposition within the flight_decomp tolerance, and
+    chain counts reconciled bitwise against the ShedLedger partition
+    (attempt 0 only — a resumed attempt's ledger partitions only its
+    own arrivals while the replayed event stream spans every attempt).
+    Plus the aggregates the chains make possible: p50/p99 of each TTFT
+    component, the speculative-acceptance trajectory, and the
+    shed/evict timeline. Runs without ``kind=serve_request`` records
+    read as ``enabled: False``."""
+    if not any(r.get("kind") == "serve_request" for r in metrics):
+        return {"enabled": False}
+    flights = flight_mod.reconstruct(metrics, trace_doc)
+    partition, attempt = flight_mod.find_partition(metrics)
+    res = flight_mod.verify(flights,
+                            partition if attempt == 0 else None)
+    spec = [{"t_s": r.get("t_s"),
+             "spec_accept_rate": r.get("spec_accept_rate")}
+            for r in metrics if r.get("kind") == "serve_tick"
+            and r.get("spec_accept_rate") is not None]
+    return {
+        "enabled": True,
+        "exact": res["exact"],
+        "flights": res["flights"],
+        "counts": res["counts"],
+        "partition_checked": res["partition_checked"],
+        "trace_checked": res["trace_checked"],
+        "decomposed": res["decomposed"],
+        "ttft_decomp_worst_s": res["ttft_decomp_worst_s"],
+        "ttft_decomp_tol_s": res["ttft_decomp_tol_s"],
+        "ttft_decomp_status": res["ttft_decomp_status"],
+        "decomposition": flight_mod.decomposition(flights),
+        "spec_accept_over_time": spec,
+        "shed_timeline": flight_mod.shed_timeline(flights),
+        # bounded: a pathological run could break every chain, and the
+        # report must stay readable — the flight CLI prints them all
+        "problems": res["problems"][:20],
+        "problem_count": len(res["problems"]),
     }
 
 
@@ -808,6 +876,7 @@ def build_report(metrics: List[Dict[str, Any]],
     devtime = devtime_section(all_events, metrics, baseline)
     alerts = alerts_section(metrics, alert_history, timing)
     serving = serving_section(metrics, baseline)
+    flights = flights_section(metrics, trace_doc)
     goodput_sec = goodput_section(metrics, goodput)
     # the correlation id: every metrics record carries it (the train
     # CLI stamps MetricsLogger.extra); older artifacts fall back to the
@@ -877,6 +946,7 @@ def build_report(metrics: List[Dict[str, Any]],
         "stragglers": stragglers,
         "regression": regression,
         "serving": serving,
+        "flights": flights,
         "goodput": goodput_sec,
         "alerts": alerts,
         "verdict": verdict,
@@ -1042,6 +1112,64 @@ def to_markdown(report: Dict[str, Any]) -> str:
                       f"({t.get('source')}, {t.get('trials')} trial(s)) "
                       f"→ decode_k {t.get('decode_k')}, layout "
                       f"{t.get('layout')}", ""]
+    fl = r.get("flights") or {}
+    if fl.get("enabled"):
+        cn = fl.get("counts") or {}
+        worst = fl.get("ttft_decomp_worst_s")
+        lines += ["## Request flights", "",
+                  "**ledger "
+                  + ("exact" if fl.get("exact") else "**INEXACT**")
+                  + f"** — {fl.get('flights')} flight(s): "
+                  f"{cn.get('completed')} completed, "
+                  f"{cn.get('evicted')} evicted, "
+                  f"{cn.get('shed_at_admission')} shed, "
+                  f"{cn.get('expired_in_queue')} expired, "
+                  f"{cn.get('rejected')} rejected, "
+                  f"{cn.get('lost')} lost"
+                  + (" · partition reconciled"
+                     if fl.get("partition_checked") else "")
+                  + (" · trace cross-checked"
+                     if fl.get("trace_checked") else ""), "",
+                  f"- TTFT decomposition "
+                  f"{fl.get('ttft_decomp_status')}: worst "
+                  f"|ttft − (queue + prefill)| = "
+                  + (f"{worst * 1e6:.2f}µs" if worst is not None
+                     else "—")
+                  + f" over {fl.get('decomposed')} flight(s) "
+                  f"(tol {fl.get('ttft_decomp_tol_s')}s)", ""]
+        dc = fl.get("decomposition") or {}
+        if any((dc.get(k) or {}).get("n") for k in dc):
+            lines += ["| component | n | p50 s | p99 s |",
+                      "|---|---|---|---|"]
+            for comp in ("queue_wait", "prefill", "ttft", "decode",
+                         "e2e"):
+                d = dc.get(comp) or {}
+                if d.get("n"):
+                    lines.append(f"| {comp} | {d['n']} | "
+                                 f"{d.get('p50_s')} | "
+                                 f"{d.get('p99_s')} |")
+            lines.append("")
+        spec_traj = fl.get("spec_accept_over_time") or []
+        if spec_traj:
+            first, last = spec_traj[0], spec_traj[-1]
+            lines += [f"- spec accept trajectory: "
+                      f"{first.get('spec_accept_rate')} @ "
+                      f"{first.get('t_s')}s → "
+                      f"{last.get('spec_accept_rate')} @ "
+                      f"{last.get('t_s')}s "
+                      f"({len(spec_traj)} tick(s))", ""]
+        tl = fl.get("shed_timeline") or []
+        if tl:
+            shown = tl[:10]
+            lines += ["- shed/evict timeline: " + "; ".join(
+                f"{e.get('event')} rid={e.get('rid')} @ "
+                f"{e.get('t_s')}s" for e in shown)
+                + (f" … ({len(tl)} total)"
+                   if len(tl) > len(shown) else ""), ""]
+        for p in fl.get("problems") or []:
+            lines.append(f"- ⚠️ {p}")
+        if fl.get("problems"):
+            lines.append("")
     gp = r.get("goodput") or {}
     if gp.get("enabled"):
         frac = gp.get("fraction")

@@ -418,7 +418,7 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
     feed it scripted offsets."""
     events: List[Dict[str, Any]] = []
     clock_sync = {}
-    spans = dropped = device_tracks = 0
+    spans = dropped = device_tracks = counter_events = 0
     for i, doc in enumerate(worker_docs):
         off_us = offsets_ns[i] / 1e3 if i < len(offsets_ns) else 0.0
         for ev in doc.get("traceEvents", []):
@@ -431,6 +431,7 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
         spans += int(meta.get("spans", 0))
         dropped += int(meta.get("dropped", 0))
         device_tracks += int(meta.get("device_tracks", 0))
+        counter_events += int(meta.get("counter_events", 0))
         clock_sync[str(i)] = meta.get("clock_sync")
     events.sort(key=lambda e: (e.get("ts", -1.0)))
     metadata = {
@@ -441,6 +442,7 @@ def merge_traces(worker_docs: Sequence[Dict[str, Any]],
         "spans": spans,
         "dropped": dropped,
         "device_tracks": device_tracks,
+        "counter_events": counter_events,
     }
     # run identity: every worker stamped the same broadcast id; the
     # first doc that carries one names the merged artifact too
@@ -488,6 +490,11 @@ def export_pod_trace(out_dir: str, *, process_index: int = 0,
         doc["traceEvents"].extend(extra_events)
         doc["metadata"]["device_tracks"] = sum(
             1 for e in extra_events if e.get("ph") == "M")
+        # ph="C" counter samples (the serve lane's KV-pool occupancy
+        # track): counted in metadata so consumers can assert the
+        # track's presence without scanning the event stream
+        doc["metadata"]["counter_events"] = sum(
+            1 for e in extra_events if e.get("ph") == "C")
     _atomic_write_json(local_path, doc)
     tracer.exported = True
     offsets = estimate_clock_offsets(process_count)

@@ -72,6 +72,14 @@ SERVE_SHED_MAX = 0.6        # serve: max shed fraction of arrivals
 # default floor is 0.0 (never breaches) and the rule never alerts
 # mid-run; deployments that care opt in via the env override.
 SPEC_ACCEPT_MIN = 0.0       # serve: min speculative acceptance rate
+# Flight-ledger TTFT decomposition tolerance (tpudist.serve.flight):
+# the ADMITTED event carries waited_s (the TTFT) AND its decomposition
+# (queue_wait_s + prefill_s), all independently rounded to 1 µs — so
+# the exact identity ttft == queue_wait + prefill survives as an
+# inequality with a pinned bound (3 roundings at ±0.5 µs plus one float
+# ulp). A reconstruction outside the bound means the scheduler's
+# decomposition drifted from its own headline number, not noise.
+FLIGHT_DECOMP_TOL_S = 5e-6  # serve: max |ttft - (queue+prefill)| (s)
 
 # Goodput (tpudist.obs.goodput): productive training time as a fraction
 # of the run's total wall-clock — cross-attempt in the offline ledger,
@@ -191,6 +199,16 @@ THRESHOLDS: Tuple[Threshold, ...] = (
                     "efficiency gate (speculation is bitwise-exact at "
                     "any rate), off by default (floor 0.0) and never a "
                     "mid-run alert"),
+    Threshold(
+        name="flight_decomp", env="TPUDIST_SERVE_FLIGHT_TOL_S",
+        default=FLIGHT_DECOMP_TOL_S, sense="max", alert=False,
+        observable="worst |ttft - (queue_wait + prefill)| across "
+                   "reconstructed request flights, in seconds",
+        description="the flight ledger's TTFT-decomposition bound: "
+                    "past the rounding budget the per-request timeline "
+                    "no longer sums to its own headline TTFT — an "
+                    "artifact-integrity gate (offline reconstruction), "
+                    "never a mid-run alert"),
     Threshold(
         name="goodput", env="TPUDIST_GOODPUT_MIN",
         default=GOODPUT_MIN, sense="min", alert=True,

@@ -14,9 +14,13 @@ a restarted attempt replays the still-live requests from the seeded
 schedule and classifies the dead attempt's in-flight slots as lost.
 
 Artifacts mirror the train lane's: ``metrics.jsonl`` (``kind=serve`` /
-``serve_tick`` / ``serve_tune`` records) under ``--save-dir``, a
-worker trace when ``--trace-dir`` is set (``prefill`` / ``decode_step``
-/ ``admit`` spans), an optional ``BENCH_SERVE.json`` (``--bench-out``),
+``serve_tick`` / ``serve_tune`` / per-request ``serve_request``
+records) under ``--save-dir``, the span trace — on by default, same
+``--trace``/``TPUDIST_TRACE`` resolution as training — exported as
+``trace.worker<i>.json`` plus the merged ``pod_trace.json`` with
+per-request flight timelines, per-slot tracks and a KV-pool occupancy
+counter track (verify offline with ``python -m tpudist.serve.flight``),
+an optional ``BENCH_SERVE.json`` (``--bench-out``),
 a Prometheus exporter while the run lives (``--live-port``), and the
 machine-readable verdict file (``TPUDIST_VERDICT_PATH``) carrying the
 three-valued SLO verdict. Exit code: 0 unless an SLO gate FAILED — an
@@ -166,9 +170,15 @@ def parse_args(argv: Optional[Sequence[str]] = None
                    help="metrics.jsonl destination")
     p.add_argument("--bench-out", type=str, default=None,
                    help="write the run summary as BENCH_SERVE.json here")
+    p.add_argument("--trace", choices=("on", "off"), default=None,
+                   help="span tracing (request flight timelines + KV "
+                        "occupancy counters); default on — same "
+                        "resolution as the train lane: flag > "
+                        "$TPUDIST_TRACE > on")
     p.add_argument("--trace-dir", type=str,
                    default=os.environ.get("TPUDIST_TRACE_DIR"),
-                   help="span-trace export dir ($TPUDIST_TRACE_DIR)")
+                   help="span-trace export dir ($TPUDIST_TRACE_DIR, "
+                        "else --save-dir)")
     p.add_argument("--live-port", type=int, default=_env_int(
         "TPUDIST_LIVE_PORT"),
         help="serve Prometheus /metrics + /status.json on this port "
@@ -241,11 +251,12 @@ class _LoopbackEmitter:
 def run(args: argparse.Namespace) -> Dict[str, Any]:
     import jax
 
-    from tpudist.config import ModelConfig, ParallelConfig
+    from tpudist.config import ModelConfig, ParallelConfig, resolve_trace
     from tpudist.metrics import MetricsLogger, log0
     from tpudist.obs import live as live_lib
     from tpudist.obs import trace as trace_lib
     from tpudist.parallel.mesh import build_mesh
+    from tpudist.serve import flight as flight_lib
     from tpudist.serve import scheduler as sched
     from tpudist.serve import tune as serve_tune
     from tpudist.serve.engine import (PagedServeEngine, ServeEngine,
@@ -258,7 +269,13 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
         d_ff=args.d_ff, max_seq_len=args.max_seq,
         n_experts=args.n_experts, expert_top_k=args.expert_top_k)
     mesh = build_mesh(ParallelConfig())
-    tracer = trace_lib.configure(enabled=bool(args.trace_dir))
+    # same resolver as the train lane (flag > $TPUDIST_TRACE > on for
+    # the switch; --trace-dir > $TPUDIST_TRACE_DIR > --save-dir for the
+    # destination): serve tracing was previously gated on --trace-dir
+    # alone, which made the pod-wide TPUDIST_TRACE=off escape hatch —
+    # and default-on flight timelines — silently train-only
+    trace_on, trace_dir = resolve_trace(args)
+    tracer = trace_lib.configure(enabled=trace_on)
 
     # --requeue-attempt's PRESENCE (any value, 0 included) means the
     # launcher's supervision loop owns this run: outcome events must
@@ -277,6 +294,8 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
     run_id = live_lib.resolve_run_id(jax.process_count())
     metrics.extra["run_id"] = run_id
     metrics.extra["requeue_attempt"] = attempt
+    # name the trace artifact like every other artifact of the attempt
+    tracer.run_info.update(run_id=run_id, requeue_attempt=attempt)
 
     # the live bus: the aggregator (alert engine + alerts.jsonl +
     # live_status.json) runs whenever live is ON — $TPUDIST_LIVE=on
@@ -395,7 +414,9 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
         # half-generated answer is not resumable), its queued/unserved
         # requests are replayed from the deterministic schedule
         for rid in sorted(prior_lost):
-            metrics.log(kind="serve_request", rid=rid, event="lost")
+            metrics.log(kind="serve_request", rid=rid,
+                        event=res_lib.LOST)
+            tracer.instant(res_lib.LOST, cat="serve", rid=rid)
             n_lost += 1
         remaining = [r for r in requests
                      if r.rid not in prior_done
@@ -463,12 +484,22 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
         _write_bench(args.bench_out, args, summary)
         log0(f"tpudist: serve bench -> {args.bench_out}")
 
-    if args.trace_dir:
-        os.makedirs(args.trace_dir, exist_ok=True)
-        tracer.export_local(
-            os.path.join(args.trace_dir, trace_lib.worker_trace_name(
-                jax.process_index())),
-            process_index=jax.process_index())
+    if tracer.enabled:
+        # full pod export, like the train lane: trace.worker<i>.json
+        # per process plus the merged pod_trace.json on the
+        # coordinator — with the serve-specific presentation appended
+        # (per-slot request tracks, ph="C" KV occupancy counters)
+        pi, pc = jax.process_index(), jax.process_count()
+        extra = flight_lib.build_extra_events(
+            tracer.events(process_index=pi), process_index=pi)
+        tinfo = trace_lib.export_pod_trace(
+            trace_dir, process_index=pi, process_count=pc,
+            tracer=tracer, extra_events=extra)
+        log0(f"tpudist: serve trace -> {tinfo['local_path']} "
+             f"({tinfo['spans']} spans, {len(extra)} slot-track/"
+             f"counter events"
+             + (f", merged {tinfo['merged_path']}"
+                if tinfo["merged_path"] else "") + ")")
     if server is not None:
         server.close()
     if agg is not None:

@@ -66,6 +66,15 @@ LOST = "lost"                  # in-flight slot a kill took (classified
 
 TERMINAL_EVENTS = (SHED, EXPIRED, REJECTED, DONE, EVICTED, LOST)
 
+# The two chain stages of a request flight, used by the flight ledger
+# (serve/flight.py) to assert the span-chain grammar: every arrival gets
+# EXACTLY ONE admission-stage event; ADMITTED flights get EXACTLY ONE
+# outcome-stage event; the other admission verdicts ARE the terminal.
+# Kept here beside the vocabulary so the grammar and the spellings
+# cannot drift apart.
+ADMISSION_EVENTS = (ADMITTED, SHED, EXPIRED, REJECTED)
+OUTCOME_EVENTS = (DONE, EVICTED, LOST)
+
 
 @dataclass
 class ShedLedger:

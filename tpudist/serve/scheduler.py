@@ -324,28 +324,44 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         # resumed attempt's lost-slot classification) replays. Buffered
         # here — durability comes from the BOUNDARY flushes below (per
         # dispatch ahead of the chaos hook, per tick otherwise), not a
-        # write+flush per outcome on the serving host path
+        # write+flush per outcome on the serving host path.
+        # Every outcome is ALSO a lifecycle instant on the flight
+        # timeline (cat=serve, keyed by rid, same spellings as the
+        # resilience vocabulary) — the flight ledger cross-checks the
+        # two streams, so they are emitted from the same call site
+        tracer.instant(ev, cat="serve", rid=rid, **kw)
         if metrics is None:
             return
         metrics.log(kind="serve_request", rid=rid, event=ev,
                     t_s=round(now(), 6), **kw)
 
+    def shared_refs() -> int:
+        # refcounts currently held on the shared-prefix pages
+        # (includes the registry's own keep-cached hold)
+        if alloc is None or not alloc.shared_pages:
+            return 0
+        return int(sum(int(alloc.refcount[p])
+                       for p in alloc.shared_pages))
+
     def finish(i: int, why: str) -> None:
         nonlocal truncated
         s = slots[i]
+        t_done = now()     # ONE sample: results/stats/event agree
         results[s.req.rid] = {
             "tokens": list(s.output), "prompt_len": s.req.prompt_len,
             "generated": s.generated, "why": why,
             "adapt_truncated": s.budget < s.req.max_new,
-            "e2e_s": now() - s.req.arrival_s}
-        stats.note_e2e(now() - s.req.arrival_s)
+            "e2e_s": t_done - s.req.arrival_s}
+        stats.note_e2e(t_done - s.req.arrival_s)
         if why == "evicted":
             truncated += 1
             led.evicted += 1
         else:
             led.completed += 1
         event(s.req.rid, res_lib.DONE if why == "done" else
-              res_lib.EVICTED, generated=s.generated)
+              res_lib.EVICTED, slot=i, generated=s.generated,
+              e2e_s=round(t_done - s.req.arrival_s, 6),
+              decode_s=round(t_done - s.first_token_s, 6))
         slots[i] = None
         if paged:
             # pages return to the pool (shared prefix pages drop one
@@ -378,6 +394,11 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         while pending and pending[0].arrival_s <= t:
             req = pending.popleft()
             led.arrived += 1
+            # the flight chain's opening marker: every arrived rid gets
+            # exactly one, whatever admission then decides
+            tracer.instant("arrive", cat="serve", rid=req.rid,
+                           arrival_s=round(req.arrival_s, 6),
+                           prompt_len=req.prompt_len)
             why = validate_request(
                 req, prompt_pad=engine.prompt_pad,
                 vocab_size=engine.model_cfg.vocab_size) \
@@ -420,10 +441,20 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                     event(req.rid, res_lib.REJECTED,
                           reason="kv_pages_exhausted")
                     continue
+                pt = engine.spec.page_tokens
+                need = -(-req.prompt_len // pt)
+                reused = min(need, len(alloc.shared_pages)) \
+                    if shared else 0
                 if not alloc.admit(i, req.prompt_len, shared=shared):
                     # pool full RIGHT NOW: backpressure, not shedding —
                     # running slots will finish and free pages
+                    tracer.instant("kv_backpressure", cat="serve",
+                                   rid=req.rid, slot=i, pages=need)
                     break
+                tracer.instant("kv_admit", cat="serve", rid=req.rid,
+                               slot=i, pages=need,
+                               pages_granted=need - reused,
+                               shared_pages_reused=reused)
             req = waiting.popleft()
             budget = req.max_new
             if cur_level > 0 and res.max_new_cap:
@@ -446,8 +477,15 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                 virtual.clock.advance(virtual.prefill_s)
             t_first = now()
             led.admitted += 1
+            # waited_s is the TTFT; its exact decomposition rides along
+            # (queue wait up to the sampled admission instant ``t``,
+            # then prefill+fence up to ``t_first``) so the flight
+            # ledger can assert ttft == queue_wait + prefill without
+            # any extra clock reads on the decision path
             event(req.rid, res_lib.ADMITTED, slot=i,
-                  waited_s=round(t_first - req.arrival_s, 6))
+                  waited_s=round(t_first - req.arrival_s, 6),
+                  queue_wait_s=round(t - req.arrival_s, 6),
+                  prefill_s=round(t_first - t, 6))
             stats.note_ttft(t_first - req.arrival_s)
             generated += 1
             slots[i] = _Slot(req=req, generated=1, first_token_s=t_first,
@@ -561,6 +599,17 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
         active_peak = max(active_peak, len(occupied))
         if paged:
             pages_peak = max(pages_peak, alloc.pages_used())
+            if tracer.enabled:
+                # KV-pool occupancy sample, one per dispatch: becomes
+                # the ph="C" counter track in pod_trace.json so cache
+                # pressure sits on the same timeline as the request
+                # spans causing it. Guarded: the refcount walk (and
+                # the clock read inside instant) must cost nothing
+                # when tracing is off
+                tracer.instant("kv_pages", cat="serve_counter",
+                               used=alloc.pages_used(),
+                               total=engine.spec.pages,
+                               shared_refs=shared_refs())
         if spec_on:
             # a verify dispatch emits a VARIABLE token count per slot:
             # ITL attributes the dispatch wall over each slot's own
@@ -586,6 +635,19 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                     accepted += n_new - 1    # minus the bonus token
                     drafted += spec_k - 1
             s = slots[i]
+            # per-slot decode attribution on the flight timeline: the
+            # ledger sums these per rid and pins the total against the
+            # terminal event's generated count (first token excluded)
+            if spec_on:
+                tracer.instant("decode_emit", cat="serve",
+                               rid=s.req.rid, slot=i, tokens=n_new,
+                               dispatch=dispatches,
+                               drafted=spec_k - 1,
+                               accepted=max(n_new - 1, 0))
+            else:
+                tracer.instant("decode_emit", cat="serve",
+                               rid=s.req.rid, slot=i, tokens=n_new,
+                               dispatch=dispatches)
             if s.generated >= s.budget:
                 finish(i, "done")
             elif s.req.prompt_len + s.generated > engine.max_seq:
@@ -633,6 +695,7 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                 extra = {"kv_pages_used": alloc.pages_used(),
                          "kv_pages_total": engine.spec.pages,
                          "kv_cache_bytes": engine.spec.bytes,
+                         "kv_shared_refs": shared_refs(),
                          "spec_accept_rate": (
                              round(accepted / drafted, 4)
                              if drafted else None)}
@@ -650,6 +713,12 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                         tokens_per_sec_per_chip=(
                             round(generated / wall / n_chips, 3)
                             if wall > 0 else None),
+                        # self-describing fixed-bucket histograms: the
+                        # live Prometheus exporter renders native
+                        # _bucket{le=...} series straight from these —
+                        # raw samples never leave the serving host
+                        ttft_hist=stats.ttft_hist(),
+                        itl_hist=stats.itl_hist(),
                         **extra)
 
     wall_s = now()
@@ -707,6 +776,8 @@ def run_serve(engine: ServeEngine, params, requests: List[Request], *,
                              if drafted else None),
         "speculate_k": spec_k,
         "shared_prefix_len": prefix_len,
+        "ttft_hist": stats.ttft_hist(),
+        "itl_hist": stats.itl_hist(),
         "results": results,
         "thresholds": {rule: rules_lib.resolve(rule)
                        for rule, _ in slo_lib.SERVE_RULES},

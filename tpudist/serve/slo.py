@@ -46,6 +46,37 @@ SERVE_RULES = (("ttft", "ttft_p99_s"),
                ("tokens_per_chip", "tokens_per_sec_per_chip"),
                ("serve_shed", "shed_fraction"))
 
+# Fixed Prometheus-native histogram buckets (upper bounds, seconds).
+# Pinned here — NOT configurable — because bucket bounds are part of the
+# metric contract: a scrape-side PromQL histogram_quantile() over two
+# runs is only comparable when both used the same edges. TTFT spans
+# queue wait + prefill (hundreds of ms under load), ITL is a per-token
+# share of one decode dispatch (single-digit ms on real hardware).
+TTFT_BUCKETS_S = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0)
+ITL_BUCKETS_S = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0)
+
+
+def hist_block(samples: List[float],
+               buckets: tuple) -> Dict[str, Any]:
+    """A self-describing histogram record for one latency family:
+    per-bucket (NOT cumulative) counts with one overflow bin, plus
+    sum/count. Carried on ``kind=serve_tick`` records so the live
+    Prometheus exporter can emit native ``_bucket{le=...}`` series
+    without holding raw samples; the bucket edges ride along so every
+    consumer renders the same edges the producer counted against."""
+    counts = [0] * (len(buckets) + 1)
+    total = 0.0
+    for s in samples:
+        total += s
+        for j, ub in enumerate(buckets):
+            if s <= ub:
+                counts[j] += 1
+                break
+        else:
+            counts[-1] += 1
+    return {"buckets": [float(b) for b in buckets], "counts": counts,
+            "sum": round(total, 6), "count": len(samples)}
+
 
 def percentile(xs: List[float], q: float) -> Optional[float]:
     """Nearest-rank percentile (q in [0, 100]); None on no samples.
@@ -84,6 +115,12 @@ class LatencyStats:
             "e2e_p50_s": percentile(self.e2e_s, 50),
             "e2e_p99_s": percentile(self.e2e_s, 99),
         }
+
+    def ttft_hist(self) -> Dict[str, Any]:
+        return hist_block(self.ttft_s, TTFT_BUCKETS_S)
+
+    def itl_hist(self) -> Dict[str, Any]:
+        return hist_block(self.itl_s, ITL_BUCKETS_S)
 
 
 def rule_status(rule: str, value: Optional[float]) -> str:
