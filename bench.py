@@ -924,13 +924,11 @@ def run_overlap_sweep(out_path: str, n_steps: int = 16, repeats: int = 2,
     # once; bucketed threads one barrier per chain link, which is what
     # stops the collective combiner re-fusing the reduces into the
     # trailing all-reduce on hardware backends
-    def _barrier_count(mode, mb):
+    def _lowered_text(cfg):
         from jax.sharding import PartitionSpec as P
 
         from tpudist.parallel import sharding as shd
         from tpudist.utils import compat
-        cfg = dataclasses.replace(base, grad_overlap=mode,
-                                  grad_bucket_mb=mb)
         state = engine.init_state(jax.random.PRNGKey(0), cfg, mesh)
         body, _, _ = engine._build_step_body(cfg, mesh)
 
@@ -943,13 +941,103 @@ def run_overlap_sweep(out_path: str, n_steps: int = 16, repeats: int = 2,
                                     check_vma=False)(st, batch)
         batch = jax.tree.map(lambda a: a[0], plan.slab(0, 1))
         staged = shd.put_batch(mesh, batch)
-        txt = jax.jit(jitted).lower(state, staged).as_text()
-        return txt.count("optimization_barrier")
+        return jax.jit(jitted).lower(state, staged).as_text()
+
+    def _barrier_count(mode, mb):
+        return _lowered_text(dataclasses.replace(
+            base, grad_overlap=mode,
+            grad_bucket_mb=mb)).count("optimization_barrier")
     program = {
         "off_barriers": _barrier_count("off", None),
         "bucketed_barrier_chain": _barrier_count(
             "bucketed", best["grad_bucket_mb"]),
     }
+
+    # ---- cross-slice half: flat vs hierarchical per slice count ----
+    # Same honesty discipline as the DP half (warm all cells, then
+    # interleave timed rounds), and the same division of labor: steps/s
+    # rides as a no-regression diagnostic (on CPU both schedules run the
+    # same reduction work; the hierarchical win is DCN byte volume, which
+    # only hardware wall clock can convert to time) while the asserted
+    # evidence is program-derived — per-step DCN bytes from the lowered
+    # StableHLO must shrink by exactly the slice size.
+    from tpudist.obs import devtime as devtime_lib
+    xs_rows = []
+    xs_cells = {}
+    slice_counts = [s for s in (2, 4, 8)
+                    if s <= n_dev and n_dev % s == 0]
+    for n_slices in slice_counts:
+        os.environ["TPUDIST_SLICE_MAP"] = str(n_slices)
+        device_slices = mesh_lib.mesh_device_slices(mesh)
+        for cross in ("flat", "hierarchical"):
+            cfg = dataclasses.replace(base, grad_overlap="bucketed",
+                                      grad_bucket_mb=4.0,
+                                      cross_slice=cross)
+            runner = probe.EpochRunner(cfg, mesh, k, plan, n_steps)
+            state = runner.init_state()
+            # compile + warm; the warm epoch runs from fresh init, so
+            # its loss doubles as the parity value
+            state, loss = runner.run_epoch(state)
+            loss_bits = float(jax.device_get(loss).ravel()[-1])
+            coll = devtime_lib.collective_bytes(_lowered_text(cfg),
+                                                device_slices)
+            print(json.dumps({"cell": [n_slices, cross],
+                              "first_epoch_loss": loss_bits,
+                              "dcn_bytes_per_step":
+                                  coll["dcn_bytes_total"]}))
+            xs_cells[(n_slices, cross)] = [runner, state, [], loss_bits,
+                                           coll]
+    os.environ["TPUDIST_SLICE_MAP"] = "2"   # the sweep's scripted map
+    for _ in range(max(repeats, 3)):
+        for key in xs_cells:
+            r = xs_cells[key]
+            t0 = time.perf_counter()
+            s, loss = r[0].run_epoch(r[1])
+            jax.device_get(loss)
+            r[1] = s
+            r[2].append((time.perf_counter() - t0) * 1000 / n_steps)
+    for (n_slices, cross), (_, _, times, loss_bits, coll) in \
+            xs_cells.items():
+        ms = statistics.median(times)
+        xs_rows.append({
+            "n_slices": n_slices, "slice_size": n_dev // n_slices,
+            "cross_slice": cross,
+            "step_ms": round(ms, 4),
+            "steps_per_sec": round(1000 / ms, 1),
+            "first_epoch_loss": loss_bits,
+            "dcn_bytes_per_step": coll["dcn_bytes_total"],
+            "ici_bytes_per_step": coll["ici_bytes_total"],
+            "n_collectives": coll["n_collectives"]})
+        print(json.dumps(xs_rows[-1]))
+    for n_slices in slice_counts:
+        flat_r = next(r for r in xs_rows
+                      if r["n_slices"] == n_slices
+                      and r["cross_slice"] == "flat")
+        hier_r = next(r for r in xs_rows
+                      if r["n_slices"] == n_slices
+                      and r["cross_slice"] == "hierarchical")
+        slice_size = n_dev // n_slices
+        if flat_r["first_epoch_loss"] != hier_r["first_epoch_loss"]:
+            raise SystemExit(
+                "overlap sweep: hierarchical loss must match flat "
+                f"bitwise at {n_slices} slices "
+                f"({hier_r['first_epoch_loss']} vs "
+                f"{flat_r['first_epoch_loss']})")
+        ratio = flat_r["dcn_bytes_per_step"] / hier_r["dcn_bytes_per_step"]
+        # exact when slice_size divides every bucket's element count
+        # (it does for this model); the loss all-reduce's 4-byte payload
+        # rides both sides, hence the sliver of tolerance
+        if slice_size > 1 and abs(ratio - slice_size) > 0.02 * slice_size:
+            raise SystemExit(
+                "overlap sweep: hierarchical DCN bytes must be "
+                f"flat/slice_size at {n_slices} slices (ratio {ratio:.4f}"
+                f" vs slice_size {slice_size})")
+        if hier_r["steps_per_sec"] < 0.7 * flat_r["steps_per_sec"]:
+            raise SystemExit(
+                "overlap sweep: hierarchical steps/s regressed beyond "
+                f"the CPU noise floor at {n_slices} slices "
+                f"({hier_r['steps_per_sec']} vs "
+                f"{flat_r['steps_per_sec']})")
 
     art = {
         "metric": "grad_overlap_steps_ratio",
@@ -977,6 +1065,10 @@ def run_overlap_sweep(out_path: str, n_steps: int = 16, repeats: int = 2,
                 r["superstep_compiles"] in (None, 1) for r in rows),
             "steps_ratio_best_vs_off": round(
                 best["steps_per_sec"] / off_row["steps_per_sec"], 4),
+            "cross_slice_rows": xs_rows,
+            "cross_slice_loss_bitwise_identical": all(
+                r["first_epoch_loss"] == off_row["first_epoch_loss"]
+                for r in xs_rows),
             "pipeline_rows": pp_rows,
             **({"pipeline_interleaved_vs_gpipe_steps_ratio": round(
                     pp_rows[1]["steps_per_sec"]

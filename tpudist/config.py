@@ -126,6 +126,16 @@ class TrainConfig:
     # way; only the schedule (and the exposed-comm fraction) moves
     grad_bucket_mb: Optional[float] = None  # bucket size bound in MB for
     # --grad-overlap bucketed. None = $TPUDIST_GRAD_BUCKET_MB, else 4
+    cross_slice: Optional[str] = None  # flat | hierarchical — how the DP
+    # gradient reduce crosses slice boundaries (parallel.overlap): flat
+    # moves the FULL gradient bytes over DCN (in-slice reduce, then
+    # cross-slice reduce on the whole vector), hierarchical
+    # reduce-scatters in-slice over ICI, all-reduces the 1/slice_size
+    # shard over DCN, all-gathers in-slice — DCN bytes drop by the
+    # slice size. Bitwise-identical loss either way (both modes pin the
+    # same slice-structured association); single-slice meshes downgrade
+    # hierarchical to flat with a logged notice. None =
+    # $TPUDIST_CROSS_SLICE, else flat
     fail_at: Optional[int] = None  # fault injection: exit(1) after this epoch
     chaos: Optional[str] = None   # scripted fault-injection plan
     # (tpudist.chaos): ";"-separated <fault>@<epoch>:<step>[:<rank>]
@@ -402,6 +412,30 @@ def resolve_grad_overlap(cfg: TrainConfig) -> tuple[str, int]:
     if mb <= 0:
         raise ValueError(f"--grad-bucket-mb must be > 0, got {mb}")
     return mode, int(mb * 2**20)
+
+
+# --cross-slice vocabulary, mirrored from overlap.CROSS_SLICE_MODES
+# (kept as a literal so config stays importable before jax — pinned
+# equal in tests, like GRAD_OVERLAP_MODES above).
+CROSS_SLICE_MODES = ("flat", "hierarchical")
+
+
+def resolve_cross_slice(cfg: TrainConfig) -> str:
+    """Resolve ``--cross-slice`` to the concrete cross-slice reduce
+    schedule. Precedence: explicit flag > ``TPUDIST_CROSS_SLICE`` >
+    flat. Like --grad-overlap, the mode applies to the explicit-
+    collective pure-DP path; the engine refuses hierarchical on meshes
+    that route gradients through the jit+shardings partitioner and
+    downgrades it (with a logged notice) on single-slice meshes, where
+    there is no DCN phase to split."""
+    mode = cfg.cross_slice
+    if mode is None:
+        mode = os.environ.get("TPUDIST_CROSS_SLICE") or "flat"
+    if mode not in CROSS_SLICE_MODES:
+        raise ValueError(
+            f"--cross-slice must be one of {CROSS_SLICE_MODES}, "
+            f"got {mode!r}")
+    return mode
 
 
 def resolve_pipeline_interleave(cfg: TrainConfig) -> int:
@@ -716,6 +750,16 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
     p.add_argument("--grad-bucket-mb", type=float, default=None,
                    help="bucket size bound for --grad-overlap bucketed "
                         "(default: $TPUDIST_GRAD_BUCKET_MB, else 4)")
+    p.add_argument("--cross-slice", type=str, default=None,
+                   choices=list(CROSS_SLICE_MODES),
+                   help="cross-slice DP reduce schedule "
+                        "(tpudist.parallel.overlap): flat = full "
+                        "gradient bytes over DCN, hierarchical = "
+                        "reduce-scatter in-slice (ICI) + all-reduce of "
+                        "the 1/slice_size shard across slices (DCN) + "
+                        "all-gather in-slice — cuts DCN bytes by the "
+                        "slice size; bitwise-identical loss either way "
+                        "(default: $TPUDIST_CROSS_SLICE, else flat)")
     p.add_argument("--cp-impl", type=str, default="ring",
                    choices=list(CP_IMPLS),
                    help="context-parallel attention: kv ring rotation "
@@ -862,6 +906,7 @@ def parse_args(argv: Optional[Sequence[str]] = None) -> TrainConfig:
         cp_impl=args.cp_impl,
         grad_overlap=args.grad_overlap,
         grad_bucket_mb=args.grad_bucket_mb,
+        cross_slice=args.cross_slice,
         fail_at=args.fail_at,
         chaos=args.chaos,
         log_every=args.log_every,

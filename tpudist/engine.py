@@ -434,7 +434,7 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
     # constraint is rejected at trace time
     loss_fn = make_loss_fn(cfg, mesh, constrain_logits=not dp)
     st_sh = None if dp else state_shardings(cfg, mesh)
-    from tpudist.config import resolve_grad_overlap
+    from tpudist.config import resolve_cross_slice, resolve_grad_overlap
     overlap_mode, bucket_bytes = resolve_grad_overlap(cfg)
     if overlap_mode != "off" and not dp:
         if any(int(s) > 1 for s in mesh.devices.shape):
@@ -451,6 +451,32 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
         # a single-device mesh has no all-reduce at all: the flag is
         # inert (a laptop dry-run of a pod launch script must not crash)
         overlap_mode = "off"
+    cross_mode = resolve_cross_slice(cfg)
+    slice_groups = None
+    if cross_mode == "hierarchical" and not dp:
+        if any(int(s) > 1 for s in mesh.devices.shape):
+            # same refusal logic as --grad-overlap: the ladder rewrites
+            # explicit psums, and the jit+shardings partitioner owns the
+            # gradient reduce on non-DP meshes
+            raise ValueError(
+                f"--cross-slice hierarchical requires the explicit-"
+                f"collective pure-DP mesh (only the 'data' axis > 1); "
+                f"this mesh routes gradients through the jit+shardings "
+                f"partitioner")
+        cross_mode = "flat"
+    if dp:
+        from tpudist.parallel import mesh as mesh_lib
+        slice_groups = mesh_lib.data_slice_groups(mesh)
+        if cross_mode == "hierarchical" and slice_groups is None:
+            # single slice: there is no DCN phase to shard, and lowering
+            # the ladder anyway would emit dead in-slice scatter/gather
+            # phases. Downgrade LOUDLY — tests and operators read this
+            # line to know the program is the flat one.
+            from tpudist.metrics import log0
+            log0("tpudist: --cross-slice hierarchical downgraded to "
+                 "flat: single-slice mesh (no cross-slice DCN phase to "
+                 "shard)")
+            cross_mode = "flat"
 
     def sgd_update(state: TrainState, loss, grads):
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
@@ -473,7 +499,9 @@ def _build_step_body(cfg: TrainConfig, mesh: Mesh):
             # math either way, only the exposed-comm fraction moves.
             grads = overlap_lib.grad_mean(grads, "data",
                                           mode=overlap_mode,
-                                          bucket_bytes=bucket_bytes)
+                                          bucket_bytes=bucket_bytes,
+                                          cross=cross_mode,
+                                          slice_groups=slice_groups)
             loss = lax.pmean(loss, "data")
             return sgd_update(state, loss, grads)
     else:
@@ -530,6 +558,24 @@ def _cost_analysis_hook(jitted, cell) -> Callable:
     return cost_analysis
 
 
+def _lowered_text_hook(jitted, cell) -> Callable:
+    """Build the ``.lowered_text()`` accessor attached beside
+    ``.cost_analysis()``: the StableHLO text of the EXACT program the
+    run dispatched (obs.devtime.collective_bytes parses its collective
+    ops into the per-fabric byte accounting the devtime record and the
+    DCN-bytes gauge carry). Lowering hits jit's trace cache after the
+    first call; None before the first call or on any failure —
+    observability must never fail a run."""
+    def lowered_text():
+        if cell[0] is None:
+            return None
+        try:
+            return jitted.lower(*cell[0]).as_text()
+        except Exception:
+            return None
+    return lowered_text
+
+
 def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
     """Build the compiled train step: (TrainState, batch) -> (TrainState, loss).
 
@@ -568,6 +614,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
             _specs[0] = _arg_specs((state, staged))
         return jitted(state, staged)
     step.cost_analysis = _cost_analysis_hook(jitted, _specs)
+    step.lowered_text = _lowered_text_hook(jitted, _specs)
     return step
 
 
@@ -698,6 +745,7 @@ def make_superstep(cfg: TrainConfig, mesh: Mesh, k: int) -> Callable:
         return jitted(*args)
     superstep.traces = traces
     superstep.cost_analysis = _cost_analysis_hook(jitted, _specs)
+    superstep.lowered_text = _lowered_text_hook(jitted, _specs)
     return superstep
 
 

@@ -81,6 +81,168 @@ def classify(name: str) -> Optional[str]:
     return "comm" if _COMM_RE.search(name) else "compute"
 
 
+# ------------------------------------- program-derived collective bytes
+#
+# CPU wall-clock cannot honestly measure a DCN-byte win (PR 12's
+# observer-effect lesson), but the LOWERED PROGRAM states it exactly:
+# every collective op carries its payload tensor type and its replica
+# groups, and the mesh knows which device ids share a slice. Parsing
+# the StableHLO text (engine's ``.lowered_text()`` hook) therefore
+# yields per-collective byte volumes per fabric as program facts — the
+# hierarchical schedule's bytes-over-DCN cut is asserted from these
+# rows, never from timing. Stays jax-free like the rest of the parser
+# half: tests and the offline report feed it saved text.
+
+# StableHLO collective ops (MLIR spelling — underscores, unlike the
+# device-timeline HLO names above).
+_COLLECTIVE_OPS = ("all_reduce", "reduce_scatter", "all_gather",
+                   "all_to_all", "collective_permute",
+                   "collective_broadcast")
+_COLLECTIVE_RE = re.compile(
+    r"stablehlo\.(" + "|".join(_COLLECTIVE_OPS) + r")\b")
+_DENSE_RE = {
+    attr: re.compile(attr + r"\s*=\s*dense<(.*?)>\s*:\s*tensor<([0-9x]*)",
+                     re.DOTALL)
+    for attr in ("replica_groups", "source_target_pairs")
+}
+# an op's type signature: "(operands) -> result" — on the op's own line
+# for region-free ops, on the "}) : (...)" closing line for the
+# region-carrying reduces
+_SIG_RE = re.compile(r":\s*\(([^()]*)\)\s*->\s*\(?\s*(tensor<[^>]*>)")
+_TENSOR_RE = re.compile(r"tensor<([^>]*)>")
+
+_MLIR_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2,
+    "i64": 8, "ui64": 8, "i32": 4, "ui32": 4, "i16": 2, "ui16": 2,
+    "i8": 1, "ui8": 1, "i1": 1,
+    "f8E4M3FN": 1, "f8E5M2": 1, "f8E4M3": 1, "f8E3M4": 1,
+}
+
+
+def _tensor_bytes(ty: str) -> Tuple[int, str]:
+    """``"2x11xf32"`` -> (88, "f32"); scalar ``"f32"`` -> (4, "f32")."""
+    parts = ty.strip().split("x")
+    dtype = parts[-1]
+    n = 1
+    for p in parts[:-1]:
+        n *= int(p)
+    return n * _MLIR_DTYPE_BYTES.get(dtype, 4), dtype
+
+
+def _parse_dense(attr: str, window: str) -> Optional[List[List[int]]]:
+    """An MLIR dense int attribute -> list of rows. Handles the
+    explicit nested-list form and the splat form (``dense<0>`` with
+    the row shape taken from the tensor type)."""
+    m = _DENSE_RE[attr].search(window)
+    if not m:
+        return None
+    body, shape = m.group(1).strip(), m.group(2)
+    dims = [int(d) for d in shape.split("x") if d]
+    if body.startswith("["):
+        rows = re.findall(r"\[([^\[\]]*)\]", body)
+        return [[int(v) for v in r.split(",") if v.strip()] for r in rows]
+    # splat: one value repeated over the whole shape
+    v = int(body)
+    n_rows = dims[0] if dims else 1
+    n_cols = dims[1] if len(dims) > 1 else 1
+    return [[v] * n_cols for _ in range(n_rows)]
+
+
+def collective_bytes(text: str, device_slices: Sequence[int]
+                     ) -> Dict[str, Any]:
+    """Per-collective byte accounting of a lowered StableHLO module.
+
+    ``device_slices[i]`` is the slice of device id ``i`` in the
+    program's device assignment (``mesh.mesh_device_slices`` — the id
+    space ``replica_groups``/``source_target_pairs`` index into).
+
+    Returns ``{"ops": [row...], "dcn_bytes_total", "ici_bytes_total",
+    "n_collectives"}``. Each row aggregates identical ops: ``op``,
+    ``dtype``, ``bytes`` (payload per instance — the larger of operand
+    and result tensors, i.e. the full vector a reduce-scatter consumes
+    or an all-gather produces), ``count``, ``fabric`` (``dcn`` when any
+    replica group spans slices, ``mixed`` for a permute with both kinds
+    of edge), and ``dcn_bytes`` (total over ``count``: payload × the
+    number of participants whose traffic crosses slices — for group
+    collectives every member of a slice-spanning group, for a permute
+    each slice-crossing source→target edge). The convention prices a
+    participant's payload once per instance, so the hierarchical ladder's
+    cross-slice all-reduce (1/slice_size shard, all N participants)
+    lands at exactly 1/slice_size of the flat schedule's — the relation
+    the acceptance tests pin. A ``lax.scan`` body lowers once, so rows
+    approximate per-step volumes regardless of superstep length."""
+    slices = list(device_slices)
+    agg: Dict[tuple, Dict[str, Any]] = {}
+    lines = text.splitlines()
+    for i, line in enumerate(lines):
+        m = _COLLECTIVE_RE.search(line)
+        if not m:
+            continue
+        op = m.group(1)
+        sig = _SIG_RE.search(line)
+        j = i
+        while sig is None and j + 1 < len(lines) and j - i < 50:
+            # region-carrying op (all_reduce / reduce_scatter): the
+            # type signature lives on the "}) : (...)" closing line
+            j += 1
+            if "}) :" in lines[j]:
+                sig = _SIG_RE.search(lines[j])
+                break
+        if sig is None:
+            continue
+        operand_tys = _TENSOR_RE.findall(sig.group(1))
+        result_ty = _TENSOR_RE.search(sig.group(2))
+        tys = operand_tys + ([result_ty.group(1)] if result_ty else [])
+        if not tys:
+            continue
+        sized = [_tensor_bytes(t) for t in tys]
+        payload, dtype = max(sized, key=lambda s: s[0])
+        groups = _parse_dense("replica_groups", line)
+        pairs = _parse_dense("source_target_pairs", line)
+        fabric = "ici"
+        dcn_participants = 0
+        if pairs is not None:
+            crossing = sum(1 for p in pairs if len(p) == 2
+                           and _crosses(p, slices))
+            dcn_participants = crossing
+            if crossing == len(pairs) and pairs:
+                fabric = "dcn"
+            elif crossing:
+                fabric = "mixed"
+        elif groups is not None:
+            for g in groups:
+                if _crosses(g, slices):
+                    dcn_participants += len(g)
+            if dcn_participants:
+                fabric = "dcn"
+        key = (op, dtype, payload, fabric, dcn_participants)
+        row = agg.setdefault(key, {
+            "op": op, "dtype": dtype, "bytes": payload, "count": 0,
+            "fabric": fabric, "dcn_bytes": 0})
+        row["count"] += 1
+        row["dcn_bytes"] += payload * dcn_participants
+    ops = sorted(agg.values(),
+                 key=lambda r: (-r["dcn_bytes"], -r["bytes"], r["op"]))
+    dcn_total = sum(r["dcn_bytes"] for r in ops)
+    ici_total = sum(r["bytes"] * r["count"] for r in ops
+                    if r["fabric"] == "ici")
+    return {"ops": ops, "dcn_bytes_total": dcn_total,
+            "ici_bytes_total": ici_total,
+            "n_collectives": sum(r["count"] for r in ops)}
+
+
+def _crosses(ids: Sequence[int], slices: List[int]) -> bool:
+    """True when the id group spans more than one slice (out-of-range
+    ids — a program lowered for a larger world than the slice table —
+    read as slice 0, the conservative single-slice answer)."""
+    seen = set()
+    for d in ids:
+        seen.add(slices[d] if 0 <= d < len(slices) else 0)
+        if len(seen) > 1:
+            return True
+    return False
+
+
 # -------------------------------------------------------- interval math
 
 

@@ -416,6 +416,7 @@ class LiveAggregator:
             "step": None, "epoch": None, "loss": None,
             "steps_per_sec": None, "straggler_ratio": None,
             "staging_overlap_fraction": None, "exposed_comm_frac": None,
+            "dcn_bytes_total": None,
             "ckpt_last_enqueue_ms": None, "ckpt_drain_ms": None,
             "ckpt_saves": 0, "resume": None, "timing_seen": False}
         self._pod_window = RollingWindow(window_s)
@@ -492,6 +493,11 @@ class LiveAggregator:
             fabric = rec.get("fabric")
             if fabric is not None:
                 self._pod["comm_fabric"] = fabric
+            if rec.get("dcn_bytes_total") is not None:
+                # program-derived per-step DCN byte volume (cross-slice
+                # schedule telemetry) — a gauge, not a counter: the
+                # program is fixed for the run
+                self._pod["dcn_bytes_total"] = rec["dcn_bytes_total"]
             # fabric-graded: a DCN-labeled record substitutes the DCN
             # ceiling but keeps the ONE "comm" rule key, so the at-exit
             # comm_status cross-check still finds its matching alert
@@ -845,6 +851,9 @@ _PROM_HELP = {
                                         "(1.0 = all H2D hidden).",
     "tpudist_exposed_comm_fraction": "Exposed-communication fraction "
                                      "of the device window.",
+    "tpudist_dcn_bytes_total": "Per-step cross-slice (DCN) collective "
+                               "bytes, derived from the lowered "
+                               "program.",
     "tpudist_straggler_ratio": "Worst host step time over pod median.",
     "tpudist_goodput_fraction": "Attempt-local productive fraction of "
                                 "wall clock (run-end estimate; the "
@@ -969,6 +978,8 @@ def prometheus_text(status: Dict[str, Any]) -> str:
            [({}, pod.get("staging_overlap_fraction"))])
     metric("tpudist_exposed_comm_fraction",
            [({}, pod.get("exposed_comm_frac"))])
+    metric("tpudist_dcn_bytes_total",
+           [({}, pod.get("dcn_bytes_total"))])
     metric("tpudist_straggler_ratio",
            [({}, pod.get("straggler_ratio"))])
     metric("tpudist_goodput_fraction",

@@ -419,7 +419,7 @@ def devtime_section(events, metrics, baseline: Optional[Dict]
     delta = (round(pod["exposed_comm_frac"] - base_frac, 6)
              if (pod["exposed_comm_frac"] is not None
                  and base_frac is not None) else None)
-    return {
+    out = {
         "comm_status": status,
         "fabric": fabric,
         "devices": devices,
@@ -430,6 +430,16 @@ def devtime_section(events, metrics, baseline: Optional[Dict]
         "baseline_exposed_comm_frac": base_frac,
         "exposed_comm_frac_delta": delta,
     }
+    # program-derived collective byte volumes (devtime.collective_bytes
+    # rows carried on the record in both cross-slice modes): the DCN
+    # bytes the schedule moves per step, surfaced next to the time split
+    # they explain
+    if recs and recs[-1].get("dcn_bytes_total") is not None:
+        rec = recs[-1]
+        out["dcn_bytes_total"] = rec["dcn_bytes_total"]
+        out["ici_bytes_total"] = rec.get("ici_bytes_total")
+        out["collectives"] = rec.get("collectives")
+    return out
 
 
 def _find_exposed_frac(doc: Any) -> Optional[float]:
@@ -1054,6 +1064,13 @@ def to_markdown(report: Dict[str, Any]) -> str:
             lines.append("- exposed comm by host phase: " + ", ".join(
                 f"{cat} {s:.3f}s"
                 for cat, s in dt["exposed_by_phase"].items()))
+            lines.append("")
+        if dt.get("dcn_bytes_total") is not None:
+            lines.append(
+                f"- collective bytes per step (program-derived): "
+                f"{dt['dcn_bytes_total']} B over DCN, "
+                f"{dt.get('ici_bytes_total') or 0} B over ICI "
+                f"({len(dt.get('collectives') or [])} op group(s))")
             lines.append("")
     co = r.get("collectives")
     if co and co.get("per_kind"):

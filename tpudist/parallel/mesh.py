@@ -175,6 +175,37 @@ def axis_fabric(mesh: Mesh, axis: str) -> str:
     return "ici"
 
 
+def axis_hops(mesh: Mesh, axis: str) -> List[str]:
+    """Per-hop fabric along a mesh axis: entry ``i`` labels the edge
+    from axis position ``i`` to ``(i+1) % size`` (the last entry is the
+    ring wrap hop, which is what a ``ppermute`` ring actually pays).
+
+    :func:`axis_fabric` collapses the whole axis to ``dcn`` if ANY hop
+    crosses slices — correct for a fused all-reduce (one collective
+    rides the slowest link it touches) but too coarse for point-to-point
+    schedules: a pipeline whose stages straddle two slices crosses DCN
+    on exactly one interior hop (plus the wrap) while every other hop
+    stays on ICI. The per-hop view lets the DCN-bytes accounting and
+    the MPMD stage plan price mixed axes exactly. A hop is ``dcn`` when
+    any pair of devices it connects (over all positions of the other
+    axes) sits on different slices."""
+    import numpy as np
+    devs = mesh.devices
+    scripted = slice_assignment(devs.ravel())
+    idx = list(mesh.axis_names).index(axis)
+    cols = np.moveaxis(devs, idx, 0).reshape(devs.shape[idx], -1)
+    size = cols.shape[0]
+    hops: List[str] = []
+    for i in range(size):
+        j = (i + 1) % size
+        crossed = any(
+            device_slice_index(cols[i, c], scripted)
+            != device_slice_index(cols[j, c], scripted)
+            for c in range(cols.shape[1]))
+        hops.append("dcn" if crossed else "ici")
+    return hops
+
+
 def mesh_fabrics(mesh: Mesh) -> Dict[str, str]:
     """Every size->1 axis's fabric label — the ``axis_fabric`` map the
     devtime record and the run report carry (axes of size 1 have no
@@ -189,3 +220,79 @@ def data_fabric(mesh: Mesh) -> str:
     if mesh.shape.get("data", 1) > 1:
         return axis_fabric(mesh, "data")
     return "ici"
+
+
+def mesh_device_slices(mesh: Mesh) -> List[int]:
+    """Slice index of every mesh device in FLAT (C-order) mesh
+    position. This is the id space a lowered program's
+    ``replica_groups`` / ``source_target_pairs`` index into
+    (``use_global_device_ids`` numbers devices by their position in
+    the computation's device assignment, which jit takes from the
+    mesh), so it is the slice table obs.devtime's collective byte
+    accounting consumes."""
+    devs = list(mesh.devices.ravel())
+    scripted = slice_assignment(devs)
+    return [device_slice_index(d, scripted) for d in devs]
+
+
+@dataclass(frozen=True)
+class SliceGroups:
+    """The slice structure of the ``data`` axis, as collective
+    subgroups: ``in_slice[s]`` holds the data-axis indices of slice
+    ``s``'s members (the ICI reduce-scatter / all-gather groups),
+    ``cross_slice[j]`` holds the ``j``-th member of every slice (the
+    DCN all-reduce groups — each moves a 1/``slice_size`` shard in the
+    hierarchical schedule). Groups are ``axis_index_groups`` for
+    collectives over the ``data`` axis inside the pure-DP shard_map,
+    where axis index == mesh position."""
+
+    n_slices: int
+    slice_size: int
+    in_slice: Tuple[Tuple[int, ...], ...]
+    cross_slice: Tuple[Tuple[int, ...], ...]
+
+
+def data_slice_groups(mesh: Mesh) -> Optional[SliceGroups]:
+    """The data axis's :class:`SliceGroups`, or None when there is no
+    slice structure to exploit (data axis of size 1, or every data
+    position on one slice — the single-slice downgrade case).
+
+    Raises when a single data position spans slices (a non-DP mesh
+    whose other axes straddle a slice boundary — in-slice/cross-slice
+    grouping is undefined there) and when slices are unequal (the
+    1/slice_size shard layout needs one shard per in-slice member in
+    every slice; an irregular scripted map is a config error, not a
+    degraded mode)."""
+    import numpy as np
+    n = mesh.shape.get("data", 1)
+    if n <= 1:
+        return None
+    devs = mesh.devices
+    scripted = slice_assignment(devs.ravel())
+    idx = list(mesh.axis_names).index("data")
+    cols = np.moveaxis(devs, idx, 0).reshape(n, -1)
+    pos_slice: List[int] = []
+    for i in range(n):
+        seen = {device_slice_index(d, scripted) for d in cols[i]}
+        if len(seen) > 1:
+            raise ValueError(
+                f"data position {i} spans slices {sorted(seen)}: "
+                f"in-slice/cross-slice grouping needs every data-axis "
+                f"position on ONE slice")
+        pos_slice.append(seen.pop())
+    by_slice: Dict[int, List[int]] = {}
+    for i, s in enumerate(pos_slice):
+        by_slice.setdefault(s, []).append(i)
+    if len(by_slice) == 1:
+        return None
+    groups = [tuple(v) for _, v in sorted(by_slice.items())]
+    sizes = {len(g) for g in groups}
+    if len(sizes) > 1:
+        raise ValueError(
+            f"unequal slice sizes {sorted(len(g) for g in groups)} on "
+            f"the data axis: the hierarchical schedule shards each "
+            f"reduce 1/slice_size and needs equal slices")
+    per = sizes.pop()
+    cross = tuple(tuple(g[j] for g in groups) for j in range(per))
+    return SliceGroups(n_slices=len(groups), slice_size=per,
+                       in_slice=tuple(groups), cross_slice=cross)

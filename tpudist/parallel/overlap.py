@@ -40,6 +40,29 @@ per-leaf pmean math is unchanged, so losses are BITWISE equal across
 ``off``/``bucketed`` (pinned in tests/test_overlap.py, the way PR 1/2
 pinned superstep parity). Only the schedule — and therefore the
 exposed-communication fraction obs.devtime measures — differs.
+
+Cross-slice dimension (``--cross-slice``): on a multi-slice mesh the
+reduce has TWO fabrics to schedule over, and the flat single all-reduce
+pays DCN on the full gradient bytes. ``hierarchical`` is the standard
+multi-slice recipe (the pjit/TPUv4 paper in PAPERS.md): reduce-scatter
+inside each slice over ICI, all-reduce ACROSS slices over DCN on the
+1/slice_size shard only, all-gather back inside the slice — DCN bytes
+per step drop by the slice size, from program structure alone. To keep
+every mode bitwise-comparable, BOTH modes use the slice-structured
+association on multi-slice meshes: ``flat`` lowers to in-slice
+all-reduce → cross-slice all-reduce on the FULL vector (the association
+XLA's hierarchical collective lowering applies on real multi-slice
+hardware anyway, made explicit the same way ``barrier_mean`` pins the
+"off" baseline); ``hierarchical`` shards the cross-slice phase. The
+CPU backend reduces rank-sequentially within a group either way, and
+reduce-scatter's per-element association matches the in-slice
+all-reduce's, so flat/hierarchical losses are BITWISE equal (pinned in
+tests/test_cross_slice.py) — the knob moves bytes-on-DCN, never math.
+Each ladder reduces its leaves as ONE concatenated flat vector per
+dtype (concatenation is element-wise identity math), so a bucket lowers
+to exactly one two-phase (flat) or three-phase (hierarchical) ladder —
+the program pin the tests count. Single-slice meshes keep the original
+per-leaf pmean program untouched.
 """
 
 from __future__ import annotations
@@ -48,10 +71,15 @@ import dataclasses
 from typing import Any, List, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 # --grad-overlap vocabulary (config.resolve_grad_overlap validates)
 GRAD_OVERLAP_MODES = ("off", "bucketed")
+
+# --cross-slice vocabulary (config.resolve_cross_slice validates; the
+# engine downgrades hierarchical to flat on single-slice meshes)
+CROSS_SLICE_MODES = ("flat", "hierarchical")
 
 # Default bucket bound: big enough that a bucket's DCN all-reduce
 # amortises its latency, small enough that the first reduce issues
@@ -118,26 +146,103 @@ def plan_buckets(tree: Any, bucket_bytes: int) -> BucketPlan:
                       bucket_bytes=int(bucket_bytes))
 
 
-def barrier_mean(grads: Any, axis: str) -> Any:
+def _slice_ladder_mean(vals: Sequence[Any], axis: str, slice_groups,
+                       cross: str) -> List[Any]:
+    """Reduce a group of grad leaves over the slice-structured ladder.
+
+    Same-dtype leaves are flattened and CONCATENATED into one vector —
+    element-wise identity math, so bitwise parity with any per-leaf
+    schedule holds — and each dtype's vector runs ONE ladder:
+
+      flat:         psum(in-slice, ICI) → psum(cross-slice, DCN, full)
+      hierarchical: psum_scatter(in-slice, ICI) → psum(cross-slice,
+                    DCN, 1/slice_size shard) → all_gather(in-slice, ICI)
+
+    then divides by the full axis size (the pmean this replaces). The
+    hierarchical vector is zero-padded to a slice_size multiple so the
+    scatter tiles evenly; padding reduces zeros that the trailing
+    static slice discards, so it never touches real elements. The
+    reduce-scatter's per-element association equals the in-slice
+    all-reduce's on every backend we pin (CPU thunk runtime reduces
+    group members rank-sequentially in both lowerings), which is what
+    makes flat↔hierarchical bitwise-equal by construction."""
+    sg = slice_groups
+    n = sg.n_slices * sg.slice_size
+    by_dtype: dict = {}
+    for pos, v in enumerate(vals):
+        by_dtype.setdefault(jnp.result_type(v), []).append(pos)
+    out: List[Any] = [None] * len(vals)
+    for dt, positions in by_dtype.items():
+        parts = [vals[p].reshape(-1) for p in positions]
+        vec = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+        size = vec.shape[0]
+        if cross == "hierarchical":
+            pad = (-size) % sg.slice_size
+            if pad:
+                vec = jnp.concatenate(
+                    [vec, jnp.zeros((pad,), dtype=vec.dtype)])
+            shard = lax.psum_scatter(vec, axis, scatter_dimension=0,
+                                     axis_index_groups=list(sg.in_slice),
+                                     tiled=True)
+            shard = lax.psum(shard, axis,
+                             axis_index_groups=list(sg.cross_slice))
+            vec = lax.all_gather(shard, axis, axis=0,
+                                 axis_index_groups=list(sg.in_slice),
+                                 tiled=True)
+            if pad:
+                vec = vec[:size]
+        else:
+            vec = lax.psum(vec, axis,
+                           axis_index_groups=list(sg.in_slice))
+            vec = lax.psum(vec, axis,
+                           axis_index_groups=list(sg.cross_slice))
+        vec = vec / n
+        off = 0
+        for p in positions:
+            ln = vals[p].size
+            out[p] = lax.slice_in_dim(vec, off, off + ln).reshape(
+                vals[p].shape)
+            off += ln
+    return out
+
+
+def _leaf_means(vals: Sequence[Any], axis: str, slice_groups,
+                cross: str) -> List[Any]:
+    """One group of leaves → their global means: the slice ladder when
+    the mesh has slice structure, else the original per-leaf pmean
+    (single-slice meshes keep the exact pre-existing program)."""
+    if slice_groups is not None and slice_groups.n_slices > 1:
+        return _slice_ladder_mean(vals, axis, slice_groups, cross)
+    return [lax.pmean(g, axis) for g in vals]
+
+
+def barrier_mean(grads: Any, axis: str, *, cross: str = "flat",
+                 slice_groups=None) -> Any:
     """``--grad-overlap off``: the pinned trailing-barrier baseline —
-    every leaf barriered TOGETHER, then per-leaf pmean. No reduce can
-    issue before the whole backward is done (see module docstring for
-    why the baseline must be pinned rather than left to the backend)."""
+    every leaf barriered TOGETHER, then reduced. No reduce can issue
+    before the whole backward is done (see module docstring for why
+    the baseline must be pinned rather than left to the backend). On a
+    multi-slice mesh the reduce is the slice ladder (one per dtype);
+    otherwise the original per-leaf pmean."""
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return grads
     held = lax.optimization_barrier(tuple(leaves))
     return jax.tree.unflatten(
-        treedef, [lax.pmean(g, axis) for g in held])
+        treedef, _leaf_means(held, axis, slice_groups, cross))
 
 
 def bucketed_mean(grads: Any, axis: str, bucket_bytes: int,
-                  plan: BucketPlan | None = None) -> Any:
-    """``--grad-overlap bucketed``: per-bucket pmeans in backward
+                  plan: BucketPlan | None = None, *, cross: str = "flat",
+                  slice_groups=None) -> Any:
+    """``--grad-overlap bucketed``: per-bucket reduces in backward
     production order, chained through ``optimization_barrier`` so the
     combiner cannot re-fuse them and the scheduler cannot sink them
     (each bucket's inputs are barriered WITH the previous bucket's
-    reduced outputs — a pure ordering edge, zero math)."""
+    reduced outputs — a pure ordering edge, zero math). On a
+    multi-slice mesh each bucket lowers to ONE slice ladder per dtype
+    (two-phase flat or three-phase hierarchical), so the ladder's DCN
+    phase is what the bucket chain pins behind backward."""
     leaves, treedef = jax.tree.flatten(grads)
     if not leaves:
         return grads
@@ -150,7 +255,7 @@ def bucketed_mean(grads: Any, axis: str, bucket_bytes: int,
         if carry:
             joined = lax.optimization_barrier(vals + carry)
             vals = joined[:len(vals)]
-        reduced = tuple(lax.pmean(v, axis) for v in vals)
+        reduced = tuple(_leaf_means(vals, axis, slice_groups, cross))
         for i, r in zip(bucket, reduced):
             out[i] = r
         carry = reduced
@@ -158,12 +263,22 @@ def bucketed_mean(grads: Any, axis: str, bucket_bytes: int,
 
 
 def grad_mean(grads: Any, axis: str, *, mode: str = "off",
-              bucket_bytes: int = 0) -> Any:
-    """The DP engine path's one entry: dispatch on ``--grad-overlap``."""
+              bucket_bytes: int = 0, cross: str = "flat",
+              slice_groups=None) -> Any:
+    """The DP engine path's one entry: dispatch on ``--grad-overlap``
+    × ``--cross-slice``. ``slice_groups`` (mesh.data_slice_groups) is
+    None on single-slice meshes — both cross modes then keep the
+    original per-leaf pmean program."""
+    if cross not in CROSS_SLICE_MODES:
+        raise ValueError(
+            f"--cross-slice must be one of {CROSS_SLICE_MODES}, "
+            f"got {cross!r}")
     if mode == "bucketed":
-        return bucketed_mean(grads, axis, bucket_bytes)
+        return bucketed_mean(grads, axis, bucket_bytes, cross=cross,
+                             slice_groups=slice_groups)
     if mode == "off":
-        return barrier_mean(grads, axis)
+        return barrier_mean(grads, axis, cross=cross,
+                            slice_groups=slice_groups)
     raise ValueError(
         f"--grad-overlap must be one of {GRAD_OVERLAP_MODES}, "
         f"got {mode!r}")

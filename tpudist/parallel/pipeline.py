@@ -46,7 +46,8 @@ the engine rejects that pairing.
 
 from __future__ import annotations
 
-from typing import Callable
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -55,6 +56,82 @@ from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
 
 from tpudist.config import ModelConfig
+
+
+@dataclass(frozen=True)
+class StagePlan:
+    """The slice-granularity MPMD view of the pipeline's stage ring.
+
+    ``stage_slices[i]`` is the slice hosting pipe position ``i`` (None
+    when the stage spans slices — the replicated-pipelines layout where
+    the DATA axis crosses slices and every ring stays inside one);
+    ``hop_fabrics[i]`` labels the ring edge ``i -> (i+1) % S``
+    (mesh.axis_hops — the wrap hop included, because the ppermute ring
+    pays it every slot). Only stage-BOUNDARY hops cross DCN in a valid
+    slice mapping; in-slice rotation (and the interleaved schedule's
+    chunk laps between boundary crossings) rides ICI. The exact per-hop
+    activation bytes come from the lowered program
+    (obs.devtime.collective_bytes prices the ppermute's
+    source_target_pairs against the slice table); the plan is the
+    topology-side statement of the same facts."""
+
+    n_stages: int
+    stage_slices: Tuple[Optional[int], ...]
+    hop_fabrics: Tuple[str, ...]
+
+    @property
+    def dcn_hops(self) -> int:
+        return sum(1 for f in self.hop_fabrics if f == "dcn")
+
+    @property
+    def fabric(self) -> str:
+        if not self.dcn_hops:
+            return "ici"
+        return "dcn" if self.dcn_hops == len(self.hop_fabrics) else "mixed"
+
+
+def stage_slice_plan(mesh: Mesh, axis: str = "pipe") -> StagePlan:
+    """Map pipeline stages to slices and label every ring hop.
+
+    Valid slice-granularity MPMD mappings only: when the pipe axis
+    actually crosses slices (any hop DCN), every stage must sit on ONE
+    slice and the slice sequence along the axis must be contiguous
+    runs — otherwise interior hops cross DCN too and the mapping
+    defeats its own point, so the plan refuses loudly instead of
+    pricing a broken topology. A pipe axis whose hops all stay in-slice
+    (single slice, or slice-replicated pipelines with DATA crossing
+    slices) is always valid."""
+    from tpudist.parallel import mesh as mesh_lib
+    import numpy as np
+    n_stages = mesh.shape[axis]
+    hops = tuple(mesh_lib.axis_hops(mesh, axis))
+    devs = mesh.devices
+    scripted = mesh_lib.slice_assignment(devs.ravel())
+    idx = list(mesh.axis_names).index(axis)
+    cols = np.moveaxis(devs, idx, 0).reshape(n_stages, -1)
+    stage_slices: list = []
+    for i in range(n_stages):
+        seen = {mesh_lib.device_slice_index(d, scripted) for d in cols[i]}
+        stage_slices.append(seen.pop() if len(seen) == 1 else None)
+    if "dcn" in hops:
+        if any(s is None for s in stage_slices):
+            bad = [i for i, s in enumerate(stage_slices) if s is None]
+            raise ValueError(
+                f"pipeline stage(s) {bad} span slices while the pipe "
+                f"axis crosses DCN: slice-granularity MPMD stages need "
+                f"each stage on ONE slice (TPUDIST_SLICE_MAP must align "
+                f"slice boundaries with pipe-axis positions)")
+        boundaries = sum(
+            1 for i in range(n_stages - 1)
+            if stage_slices[i] != stage_slices[i + 1])
+        if boundaries != len(set(stage_slices)) - 1:
+            raise ValueError(
+                f"stage-to-slice map {stage_slices} is not contiguous: "
+                f"each slice must own a contiguous run of stages, else "
+                f"interior ring hops cross DCN too and the mapping "
+                f"defeats the hierarchical schedule")
+    return StagePlan(n_stages=n_stages, stage_slices=tuple(stage_slices),
+                     hop_fabrics=hops)
 
 
 def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
@@ -110,6 +187,21 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             f"n_layers={cfg.n_layers} not divisible by "
             f"pipe*interleave={n_stages}*{v}")
     perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    # slice-granularity MPMD promotion: validate the stage-to-slice
+    # mapping up front (misaligned scripted maps refuse loudly at build
+    # time, not mid-run) and announce when stage-boundary hops cross
+    # DCN — the program itself is IDENTICAL either way (one SPMD ring;
+    # the fabric each hop rides is a topology fact the plan and the
+    # devtime byte accounting carry), which is what keeps flat-vs-slice
+    # loss parity bitwise and CI-testable on CPU.
+    plan = stage_slice_plan(mesh, axis=axis)
+    if plan.dcn_hops:
+        from tpudist.metrics import log0
+        log0(f"tpudist: pipeline stages span "
+             f"{len(set(plan.stage_slices))} slice(s): "
+             f"{plan.dcn_hops}/{len(plan.hop_fabrics)} ring hop(s) "
+             f"cross DCN (interleave v={v}: chunk rotation between "
+             f"boundary crossings rides ICI)")
 
     def loss(params: dict, tokens: jax.Array) -> jax.Array:
         # auto-M resolves against the actual batch (static under jit):
@@ -296,4 +388,5 @@ def make_pp_loss_fn(cfg: ModelConfig, mesh: Mesh, *,
             params, x_emb, targets,
             jnp.arange(n_stages, dtype=jnp.int32))
 
+    loss.stage_plan = plan
     return loss

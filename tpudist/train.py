@@ -535,6 +535,27 @@ def run(cfg: TrainConfig) -> float:
              f" MB ({obs_fields['hbm_source']})"
              + (f", {100 * obs_fields['hbm_peak_fraction']:.1f}% of device"
                 if obs_fields.get("hbm_peak_fraction") else ""))
+    # program-derived collective bytes (obs.devtime.collective_bytes):
+    # every collective in the lowered step, sized op-shape × dtype and
+    # labeled per fabric from its replica groups × the mesh's slice
+    # table — the DCN-byte figure the cross-slice schedule moves, read
+    # from program facts (CPU timing can't see it). Advisory: any
+    # failure leaves the fields off the record.
+    coll = None
+    try:
+        from tpudist.parallel import mesh as mesh_lib
+        _step_fn = superstep if superstep is not None else train_step
+        _text = _step_fn.lowered_text()
+        if _text:
+            coll = devtime_lib.collective_bytes(
+                _text, mesh_lib.mesh_device_slices(mesh))
+    except Exception:
+        coll = None
+    if coll is not None and coll["n_collectives"]:
+        log0(f"tpudist: collectives {coll['n_collectives']} op(s)/step: "
+             f"{coll['dcn_bytes_total']} B dcn, "
+             f"{coll['ici_bytes_total']} B ici (program-derived)")
+
     # devtime ingest: parse this worker's --profile-window capture into
     # the compute / exposed-communication split (obs.devtime) — the
     # kind=devtime record, the comm_status verdict, and the device
@@ -559,11 +580,20 @@ def run(cfg: TrainConfig) -> float:
             dev_events = devtime_lib.device_events(
                 analysis, process_index=ctx.process_index,
                 anchor_us=(win.anchor_ns or 0) / 1e3)
+            # collective byte volumes ride the record in BOTH cross-
+            # slice modes (the flat baseline included — a comparison
+            # needs a same-schema baseline row)
+            byte_fields = {}
+            if coll is not None:
+                byte_fields = dict(
+                    dcn_bytes_total=coll["dcn_bytes_total"],
+                    ici_bytes_total=coll["ici_bytes_total"],
+                    collectives=coll["ops"])
             metrics.log(
                 kind="devtime", comm_status=devtime_status,
                 fabric=fabric, axis_fabric=fabrics,
                 capture=win.capture_dir, dispatches=win.seen,
-                process_index=ctx.process_index, **pod,
+                process_index=ctx.process_index, **pod, **byte_fields,
                 per_device=[{"device": name, **d}
                             for name, d in analysis["devices"].items()])
             log0(f"tpudist: devtime {devtime_status}: "

@@ -39,6 +39,9 @@ __all__ = ["Candidate", "TuneOutcome", "autotune", "cache_mod",
 
 DEFAULT_TRIALS = 12
 
+# wire order for the multi-host broadcast: index+1 is the enum value
+CROSS_SLICE_ENUM = ("flat", "hierarchical")
+
 
 @dataclasses.dataclass(frozen=True)
 class TuneOutcome:
@@ -70,7 +73,8 @@ def _heuristic_candidate(cfg, *, state_bytes: int = 0,
         remat=cfg.remat, grad_accum_steps=cfg.grad_accum_steps,
         grad_bucket_mb=(round(bucket_bytes / 2**20, 4)
                         if mode == "bucketed" else None),
-        pipeline_interleave=config_lib.resolve_pipeline_interleave(cfg))
+        pipeline_interleave=config_lib.resolve_pipeline_interleave(cfg),
+        cross_slice=config_lib.resolve_cross_slice(cfg))
 
 
 def _sync_candidate(cand: Optional[Candidate],
@@ -95,6 +99,9 @@ def _sync_candidate(cand: Optional[Candidate],
         -1.0 if (cand is None or cand.grad_bucket_mb is None)
         else float(cand.grad_bucket_mb),
         float(cand.pipeline_interleave if cand else 0),
+        # cross_slice enum: 0 = None, 1 = flat, 2 = hierarchical
+        0.0 if (cand is None or cand.cross_slice is None)
+        else float(1 + CROSS_SLICE_ENUM.index(cand.cross_slice)),
     ], np.float64)
     dec = multihost_utils.broadcast_one_to_all(enc)
     if dec[1] < 0.5:
@@ -105,7 +112,10 @@ def _sync_candidate(cand: Optional[Candidate],
         remat=bool(dec[4] > 0.5),
         grad_accum_steps=int(dec[5]),
         grad_bucket_mb=(None if dec[6] < 0 else float(dec[6])),
-        pipeline_interleave=int(dec[7])), bool(dec[0] > 0.5)
+        pipeline_interleave=int(dec[7]),
+        cross_slice=(None if int(dec[8]) == 0
+                     else CROSS_SLICE_ENUM[int(dec[8]) - 1])
+    ), bool(dec[0] > 0.5)
 
 
 def _sync_result(res: "probe_mod.ProbeResult") -> "probe_mod.ProbeResult":
@@ -159,7 +169,8 @@ def autotune(cfg, mesh, plan, *, mode: str, metrics: Any = None,
                           grad_accum_steps=int(t["grad_accum_steps"]),
                           grad_bucket_mb=t.get("grad_bucket_mb"),
                           pipeline_interleave=int(
-                              t.get("pipeline_interleave") or 0))
+                              t.get("pipeline_interleave") or 0),
+                          cross_slice=t.get("cross_slice"))
         hit = True
     tuned, hit = _sync_candidate(tuned, hit)
     if hit and tuned is not None:
@@ -239,12 +250,15 @@ def _probe_search(cfg, mesh, plan, start: Candidate, *, trials_budget: int,
         mesh.shape.get("data", 1) * mesh.shape.get("fsdp", 1), 1)
     # the overlap-plane axes only exist where the mesh makes them real:
     # bucket bytes on the explicit-DP mesh, virtual stages on pipe > 1
+    from tpudist.parallel import mesh as mesh_lib
     from tpudist.parallel import sharding as shd
+    sg = mesh_lib.data_slice_groups(mesh)
     axes = search_mod.build_space(
         cfg, batch_ways=batch_ways,
         heuristic_budget_mb=start.staging_budget_mb,
         dp_overlap=shd.pure_dp(mesh),
-        pipe_stages=mesh.shape.get("pipe", 1))
+        pipe_stages=mesh.shape.get("pipe", 1),
+        n_slices=(sg.n_slices if sg is not None else 1))
     by_key: Dict[tuple, probe_mod.ProbeResult] = {}
 
     def raw_probe(cand: Candidate) -> probe_mod.ProbeResult:
@@ -318,6 +332,7 @@ def _log_record(out: TuneOutcome, metrics: Any) -> TuneOutcome:
                     grad_accum_steps=out.tuned.grad_accum_steps,
                     grad_bucket_mb=out.tuned.grad_bucket_mb,
                     pipeline_interleave=out.tuned.pipeline_interleave,
+                    cross_slice=out.tuned.cross_slice,
                     steps_per_sec=out.steps_per_sec,
                     baseline_steps_per_sec=out.baseline_steps_per_sec)
     return out

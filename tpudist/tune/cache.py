@@ -37,6 +37,25 @@ def config_grad_overlap_mode(cfg) -> str:
         return "off"
 
 
+def _config_cross_slice(cfg) -> str:
+    """The resolved ``--cross-slice`` mode for the fingerprint."""
+    from tpudist.config import resolve_cross_slice
+    try:
+        return resolve_cross_slice(cfg)
+    except ValueError:
+        return "flat"
+
+
+def _mesh_slices(mesh) -> list:
+    """The mesh's slice partition (``TPUDIST_SLICE_MAP`` resolved), as a
+    JSON-able list — [] when unsliced."""
+    try:
+        from tpudist.parallel import mesh as mesh_lib
+        return [int(s) for s in mesh_lib.mesh_device_slices(mesh)]
+    except Exception:
+        return []
+
+
 def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
     """Hex fingerprint of the tuning situation (see module docstring)."""
     import jax
@@ -59,9 +78,14 @@ def fingerprint(cfg, mesh, *, device_kind: Optional[str] = None) -> str:
         # entry measured with the barrier all-reduce must not serve a
         # bucketed run (and the search space itself differs)
         "grad_overlap": config_grad_overlap_mode(cfg),
+        "cross_slice": _config_cross_slice(cfg),
         "pp_microbatches": cfg.pp_microbatches,
         "mesh": dict(zip(mesh.axis_names,
                          (int(s) for s in mesh.devices.shape))),
+        # the slice partition changes which cross_slice points exist and
+        # what each one lowers to — a point tuned on a 2-slice mesh must
+        # not serve a 4-slice run of the same shape
+        "slices": _mesh_slices(mesh),
         "n_devices": jax.device_count(),
         "n_processes": jax.process_count(),
         "device_kind": device_kind,
@@ -98,6 +122,9 @@ def _validate_train_tuned(tuned: Dict[str, Any]) -> bool:
         return False
     v = tuned.get("pipeline_interleave")
     if v is not None and int(v) < 0:
+        return False
+    cs = tuned.get("cross_slice")
+    if cs is not None and cs not in ("flat", "hierarchical"):
         return False
     return True
 

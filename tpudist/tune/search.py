@@ -43,7 +43,7 @@ from tpudist.config import SUPERSTEP_CAP, TrainConfig
 # both are pure SCHEDULE coordinates — bitwise-identical loss at every
 # value (parallel.overlap / parallel.pipeline pin this) — so they never
 # need the math-axis commit margin, just a measured win.
-AXES = ("k", "staging_budget_mb", "grad_bucket_mb",
+AXES = ("k", "staging_budget_mb", "grad_bucket_mb", "cross_slice",
         "pipeline_interleave", "remat", "grad_accum_steps")
 
 # Axes where the knob monotonically raises memory/recompute pressure:
@@ -88,6 +88,10 @@ class Candidate:
     # the axes only enter the space when the run's mesh makes them real)
     grad_bucket_mb: Optional[float] = None
     pipeline_interleave: int = 0
+    # cross-slice reduce schedule: a pure SCHEDULE coordinate like the
+    # bucket size (parallel.overlap pins bitwise parity across modes),
+    # gated to multi-slice DP meshes by build_space
+    cross_slice: Optional[str] = None
 
     def apply(self, cfg: TrainConfig) -> TrainConfig:
         out = dataclasses.replace(
@@ -100,6 +104,8 @@ class Candidate:
         if self.pipeline_interleave:
             out = dataclasses.replace(
                 out, pipeline_interleave=self.pipeline_interleave)
+        if self.cross_slice is not None:
+            out = dataclasses.replace(out, cross_slice=self.cross_slice)
         return out
 
     def replace(self, **kw) -> "Candidate":
@@ -146,8 +152,8 @@ PIPELINE_INTERLEAVE_LADDER = (1, 2, 4, 8)
 
 def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
                 heuristic_budget_mb: Optional[float] = None,
-                dp_overlap: bool = False, pipe_stages: int = 1
-                ) -> Dict[str, List[Any]]:
+                dp_overlap: bool = False, pipe_stages: int = 1,
+                n_slices: int = 1) -> Dict[str, List[Any]]:
     """The bounded search space for this run's config.
 
     * ``k``: the legal divisor ladder (:func:`k_candidates`).
@@ -158,6 +164,10 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
       configured value — only when ``dp_overlap`` says the mesh has an
       explicit DP all-reduce AND ``--grad-overlap bucketed`` is on (a
       bucket size is meaningless otherwise).
+    * ``cross_slice``: both reduce schedules, led by the run's resolved
+      mode — only on multi-slice DP meshes (``n_slices > 1`` with
+      ``dp_overlap``): a single-slice hierarchical downgrades to flat
+      anyway, so the coordinate would probe the identical program twice.
     * ``pipeline_interleave``: virtual-stage counts the layer count
       divides into — only on pipeline meshes (``pipe_stages > 1``) with
       auto microbatching or an S-divisible explicit M (the interleaved
@@ -167,7 +177,7 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
     * ``grad_accum_steps``: {1, 2, 4} filtered to divide the per-shard
       batch (the same divisibility train.run enforces).
     """
-    from tpudist.config import (resolve_grad_overlap,
+    from tpudist.config import (resolve_cross_slice, resolve_grad_overlap,
                                 resolve_pipeline_interleave)
     budgets: List[Optional[float]] = [heuristic_budget_mb]
     if heuristic_budget_mb is not None:
@@ -182,6 +192,11 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
     if dp_overlap and mode == "bucketed":
         lead = round(bucket_bytes / 2**20, 4)
         buckets = [lead] + [b for b in GRAD_BUCKET_LADDER_MB if b != lead]
+    cross: List[Optional[str]] = []
+    if dp_overlap and n_slices > 1:
+        lead = resolve_cross_slice(cfg)
+        cross = [lead] + [m for m in ("flat", "hierarchical")
+                          if m != lead]
     interleaves: List[int] = []
     if pipe_stages > 1 and layered:
         v0 = resolve_pipeline_interleave(cfg)
@@ -197,6 +212,7 @@ def build_space(cfg: TrainConfig, *, batch_ways: int = 1,
         "k": k_candidates(cfg),
         "staging_budget_mb": budgets,
         "grad_bucket_mb": buckets,
+        "cross_slice": cross,
         "pipeline_interleave": interleaves,
         "remat": ([cfg.remat, not cfg.remat] if layered else [cfg.remat]),
         "grad_accum_steps": gas,
