@@ -364,6 +364,127 @@ def run_staging_sweep(out_path: str, n_steps: int = 136,
     return art
 
 
+def run_memory_sweep(out_path: str, n_steps: int = 136) -> dict:
+    """The memory-ledger row, BENCH_MEMORY.json: the HBM bucket bytes
+    behind the two fixed-budget claims, computed from the SAME ledger
+    arithmetic the train/serve lanes record (tpudist.obs.memledger) —
+    (a) dense vs paged KV pool bytes for the serve lane's tiny
+    transformer (pool + trash page + page table vs slots x max_seq),
+    (b) full-epoch vs double-buffered streamed slab residency for the
+    staging lane's over-budget tiny-MLP epoch (plan_slabs' own cut).
+    Each row carries the ledger-derived columns (bucket bytes, headroom
+    fraction, exactness) so the artifact states not just "paged is
+    smaller" but how much device headroom each choice buys. Headline =
+    paged/dense KV bucket byte ratio (< 1.0 is the claim)."""
+    from tpudist.obs import memledger as memledger_lib
+    from tpudist.parallel import build_mesh
+    from tpudist.parallel import sharding as shd
+    from tpudist.serve import kvcache
+    from tpudist.serve.engine import init_params
+
+    hbm = int(engine._device_hbm_bytes())
+    rows = []
+
+    def ledger_cols(led):
+        return {"headroom_fraction": led["headroom_fraction"],
+                "headroom_bytes": led["buckets"]["headroom"],
+                "exact": led["exact"]}
+
+    # (a) the serve lane's KV pair: same tiny transformer + pool shape
+    # as run_serve_sweep's fixed-HBM pair, bytes from the specs' own
+    # accounting (PagedCacheSpec.bytes includes trash page + table)
+    model_cfg = ModelConfig(name="transformer", vocab_size=256,
+                            n_layers=2, d_model=64, n_heads=4,
+                            n_kv_heads=2, d_ff=128, max_seq_len=64)
+    slots, max_seq, prompt_pad = 4, 64, 16
+    mesh = build_mesh(ParallelConfig())
+    params = init_params(model_cfg, mesh, seed=0)
+    params_bytes = engine.state_bytes_per_device(params)
+    dense_spec = kvcache.CacheSpec.from_model(
+        model_cfg, slots=slots, max_seq=max_seq)
+    paged_spec = kvcache.PagedCacheSpec.from_model(
+        model_cfg, slots=2 * slots, max_seq=max_seq, page_tokens=8,
+        pages=30)
+    for mode, spec in (("dense", dense_spec), ("paged", paged_spec)):
+        led = memledger_lib.build_ledger(
+            total_hbm_bytes=hbm, params_bytes=params_bytes,
+            kv_pool_bytes=spec.bytes, mode="serve")
+        rows.append({"lane": "serve_kv", "mode": mode,
+                     "slots": spec.slots,
+                     "kv_pool_bytes": spec.bytes,
+                     **ledger_cols(led)})
+        print(json.dumps(rows[-1]))
+    dense_kv, paged_kv = rows[0], rows[1]
+    if paged_kv["kv_pool_bytes"] >= dense_kv["kv_pool_bytes"]:
+        raise SystemExit(
+            "memory sweep: paged KV bucket must be strictly smaller "
+            f"than dense ({paged_kv['kv_pool_bytes']} vs "
+            f"{dense_kv['kv_pool_bytes']} bytes)")
+
+    # (b) the staging lane's slab pair: run_staging_sweep's over-budget
+    # epoch shape, resident bytes from plan_slabs (x2 when streaming —
+    # double-buffered) — no device work, this is the ledger's own math
+    cfg = TrainConfig(batch_size=64, lr=1e-3, seed=0,
+                      data=DataConfig(n_samples=n_steps * 64),
+                      parallel=ParallelConfig(data=-1))
+    k = 32
+    plan = _sweep_plan(cfg, n_steps)
+    batch_shards = mesh.shape["data"] * mesh.shape["fsdp"]
+    step_bytes = max(1, plan.bytes_per_step // batch_shards)
+    budget = int(2.5 * k * step_bytes)
+    state = engine.init_state(jax.random.PRNGKey(cfg.seed), cfg, mesh)
+    st_params = engine.state_bytes_per_device(state.params)
+    st_opt = engine.state_bytes_per_device(state.opt_state)
+    for mode, b in (("full", None), ("streamed", budget)):
+        splan = shd.plan_slabs(n_steps, k, step_bytes, b)
+        resident = (min(2, splan.n_slabs) * splan.slab_bytes
+                    if splan.streamed else splan.slab_bytes)
+        led = memledger_lib.build_ledger(
+            total_hbm_bytes=hbm, params_bytes=st_params,
+            opt_state_bytes=st_opt, slab_bytes=resident, mode="train")
+        rows.append({"lane": "staging_slabs", "mode": mode,
+                     "budget_bytes": b, "n_slabs": splan.n_slabs,
+                     "slab_resident_bytes": resident,
+                     **ledger_cols(led)})
+        print(json.dumps(rows[-1]))
+    full_row, streamed_row = rows[2], rows[3]
+    if streamed_row["slab_resident_bytes"] \
+            >= full_row["slab_resident_bytes"]:
+        raise SystemExit(
+            "memory sweep: streamed slab residency must be strictly "
+            "smaller than the full-epoch stage "
+            f"({streamed_row['slab_resident_bytes']} vs "
+            f"{full_row['slab_resident_bytes']} bytes)")
+
+    art = {
+        "metric": "paged_vs_dense_kv_bytes_ratio",
+        "value": round(paged_kv["kv_pool_bytes"]
+                       / dense_kv["kv_pool_bytes"], 4),
+        "unit": "paged KV bucket bytes / dense KV bucket bytes "
+                "(< 1.0 at 2x the slots)",
+        "detail": {
+            "device": jax.devices()[0].device_kind,
+            "n_devices": jax.device_count(),
+            "total_hbm_bytes": hbm,
+            "rows": rows,
+            "staging_resident_ratio": round(
+                streamed_row["slab_resident_bytes"]
+                / full_row["slab_resident_bytes"], 4),
+            "headroom_gain_fraction_kv": round(
+                paged_kv["headroom_fraction"]
+                - dense_kv["headroom_fraction"], 6),
+            "headroom_gain_fraction_staging": round(
+                streamed_row["headroom_fraction"]
+                - full_row["headroom_fraction"], 6),
+        },
+    }
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1)
+    print(json.dumps({key: art[key]
+                      for key in ("metric", "value", "unit")}))
+    return art
+
+
 def run_dispatch_sweep(out_path: str, n_steps: int = 128,
                        repeats: int = 5) -> dict:
     """The dispatch-overhead row: steps/s on the tiny MLP at superstep
@@ -1311,6 +1432,14 @@ def main() -> None:
                         "write BENCH_STAGING.json")
     p.add_argument("--staging-out", type=str, default=os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_STAGING.json"))
+    p.add_argument("--memory-sweep", action="store_true",
+                   help="compute the HBM ledger's bucket bytes for "
+                        "dense-vs-paged KV and full-vs-streamed slab "
+                        "residency (tpudist.obs.memledger arithmetic, "
+                        "ledger-derived headroom columns); write "
+                        "BENCH_MEMORY.json")
+    p.add_argument("--memory-out", type=str, default=os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_MEMORY.json"))
     p.add_argument("--tune-sweep", action="store_true",
                    help="bench the measured-probe autotuner against the "
                         "dispatch sweep (heuristic-pick vs autotuned "
@@ -1393,6 +1522,9 @@ def main() -> None:
         return
     if args.staging_sweep:
         run_staging_sweep(args.staging_out)
+        return
+    if args.memory_sweep:
+        run_memory_sweep(args.memory_out)
         return
     if args.tune_sweep:
         run_tune_sweep(args.tune_out)

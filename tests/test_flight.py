@@ -450,7 +450,7 @@ def test_report_folds_request_flights_section(tmp_path, devices8,
     recs = flight_lib.load_metrics(str(tmp_path / "metrics.jsonl"))
     trace_doc = json.load(open(tmp_path / "pod_trace.json"))
     rep = report_lib.build_report(recs, trace_doc)
-    assert rep["schema"] == report_lib.REPORT_SCHEMA_VERSION == 7
+    assert rep["schema"] == report_lib.REPORT_SCHEMA_VERSION == 8
     fl = rep["flights"]
     assert fl["enabled"] and fl["exact"], fl["problems"]
     assert fl["partition_checked"] and fl["trace_checked"] > 0

@@ -59,7 +59,7 @@ def test_rules_table_names_and_alert_subset():
     assert names == {"straggler", "staging", "comm", "comm_dcn",
                      "regress", "stall", "trace_drop", "ttft", "itl",
                      "tokens_per_chip", "serve_shed", "spec_accept",
-                     "flight_decomp", "goodput"}
+                     "flight_decomp", "goodput", "hbm_headroom"}
     # every rule but the artifact-quality ones, the DCN threshold row,
     # and the off-by-default speculative-acceptance floor is a live
     # alert (comm_dcn is a per-fabric CEILING the comm alert
@@ -585,6 +585,11 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
     # a run-end goodput estimate under the floor (obs.goodput)
     goodput_frac = 0.1
     agg.ingest({"kind": "goodput", "fraction": goodput_frac}, now=clk.t)
+    # an over-committed memory ledger (negative headroom fails even at
+    # the default 0.0 floor)
+    headroom_frac = -0.1
+    agg.ingest({"kind": "memledger", "headroom_fraction": headroom_frac},
+               now=clk.t)
     fired = {a["alert"] for a in agg.engine.firing()}
     assert fired == {t.name for t in rules_lib.ALERT_RULES}, fired
 
@@ -605,6 +610,8 @@ def test_online_alerts_match_every_at_exit_fail(tmp_path):
         "serve_shed_status"] == verdict_lib.FAIL
     assert verdict_lib.goodput_status(goodput_frac) == verdict_lib.FAIL
     assert agg.snapshot()["pod"]["goodput_fraction"] == goodput_frac
+    assert verdict_lib.hbm_headroom_status(headroom_frac) \
+        == verdict_lib.FAIL
     agg.close()
 
 
@@ -660,6 +667,7 @@ tpudist_alert_firing{alert="itl"} 0
 tpudist_alert_firing{alert="tokens_per_chip"} 0
 tpudist_alert_firing{alert="serve_shed"} 0
 tpudist_alert_firing{alert="goodput"} 0
+tpudist_alert_firing{alert="hbm_headroom"} 0
 # HELP tpudist_alerts_total Alert fire/resolve transitions so far.
 # TYPE tpudist_alerts_total counter
 tpudist_alerts_total 1

@@ -279,17 +279,26 @@ STAGING_FLOOR_FRACTION = 0.05
 
 
 def resolve_staging_budget_bytes(cfg: TrainConfig, *, state_bytes: int = 0,
-                                 hbm_bytes: Optional[float] = None
+                                 hbm_bytes: Optional[float] = None,
+                                 program_temp_bytes: Optional[int] = None
                                  ) -> Optional[int]:
     """Resolve ``--staging-budget-mb`` to a per-device byte budget for
     epoch staging (``sharding.plan_slabs``), or ``None`` for "unbounded"
     (always the full-epoch fast path).
 
     Precedence: explicit flag > ``TPUDIST_STAGING_BUDGET_MB`` > auto.
-    Auto derives from the device's reported memory minus a conservative
-    train-state multiple — on backends that report no limit (CPU tests)
-    the 16 GB default makes small epochs take the fast path, which is
-    exactly the seed behavior.
+    Auto derives from the device's reported memory minus the train
+    state and its working margin — ledger-informed when a prior run's
+    memory ledger measured the compiled programs' real scratch
+    (``program_temp_bytes``, obs.memledger): the margin is then
+    ``state + measured temp`` instead of the conservative
+    ``STAGING_STATE_HEADROOM x state`` guess (the 4x heuristic stays
+    the fallback; the train loop logs which path won). The budget only
+    moves slab CUT points, which the superstep's lo/hi masking keeps
+    loss-invariant — so a ledger-informed budget is bitwise
+    loss-neutral by construction (pinned in tests). On backends that
+    report no limit (CPU tests) the 16 GB default makes small epochs
+    take the fast path, which is exactly the seed behavior.
     """
     mb = cfg.staging_budget_mb
     if mb is None:
@@ -303,8 +312,11 @@ def resolve_staging_budget_bytes(cfg: TrainConfig, *, state_bytes: int = 0,
         return int(mb * 2**20)
     if hbm_bytes is None:
         return None
-    free = max(hbm_bytes - STAGING_STATE_HEADROOM * state_bytes,
-               hbm_bytes * STAGING_FLOOR_FRACTION)
+    if program_temp_bytes is not None and program_temp_bytes >= 0:
+        margin = state_bytes + program_temp_bytes
+    else:
+        margin = STAGING_STATE_HEADROOM * state_bytes
+    free = max(hbm_bytes - margin, hbm_bytes * STAGING_FLOOR_FRACTION)
     return int(free * STAGING_FREE_FRACTION)
 
 

@@ -558,6 +558,27 @@ def _cost_analysis_hook(jitted, cell) -> Callable:
     return cost_analysis
 
 
+def _memory_analysis_hook(jitted, cell) -> Callable:
+    """Build the ``.memory_analysis()`` accessor attached beside
+    ``.cost_analysis()``: XLA's memory plan for the EXACT program the
+    run dispatched — argument/output/temp/generated-code bytes
+    (tpudist.obs.memledger's program_temp bucket reads this). Same
+    contract as the cost hook: lowering hits jit's trace cache after
+    the first call; None before the first call, on backends without
+    memory planning, or on any failure — observability must never fail
+    a run."""
+    def memory_analysis():
+        if cell[0] is None:
+            return None
+        try:
+            mem = compat.memory_analysis(
+                jitted.lower(*cell[0]).compile())
+            return mem or None
+        except Exception:
+            return None
+    return memory_analysis
+
+
 def _lowered_text_hook(jitted, cell) -> Callable:
     """Build the ``.lowered_text()`` accessor attached beside
     ``.cost_analysis()``: the StableHLO text of the EXACT program the
@@ -615,6 +636,7 @@ def make_train_step(cfg: TrainConfig, mesh: Mesh) -> Callable:
         return jitted(state, staged)
     step.cost_analysis = _cost_analysis_hook(jitted, _specs)
     step.lowered_text = _lowered_text_hook(jitted, _specs)
+    step.memory_analysis = _memory_analysis_hook(jitted, _specs)
     return step
 
 
@@ -746,6 +768,7 @@ def make_superstep(cfg: TrainConfig, mesh: Mesh, k: int) -> Callable:
     superstep.traces = traces
     superstep.cost_analysis = _cost_analysis_hook(jitted, _specs)
     superstep.lowered_text = _lowered_text_hook(jitted, _specs)
+    superstep.memory_analysis = _memory_analysis_hook(jitted, _specs)
     return superstep
 
 

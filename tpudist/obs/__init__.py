@@ -21,7 +21,10 @@ of a timeout (DESIGN.md "Observability"):
     plus ``metrics.jsonl`` (``python -m tpudist.obs.report``);
   * :mod:`goodput` — the cross-attempt goodput ledger: productive vs
     badput wall-clock across every requeue attempt of a ``run_id``
-    (``python -m tpudist.obs.goodput``).
+    (``python -m tpudist.obs.goodput``);
+  * :mod:`memledger` — the per-device HBM ledger: program-derived
+    exact bucket partition, headroom grading, and the OOM-forensics
+    CLI (``python -m tpudist.obs.memledger``).
 
 :class:`PodObserver` is the facade the train loop wires through: one
 object to start, feed progress, ask for record fields, and close.
@@ -63,6 +66,11 @@ class PodObserver:
         self.hosts = HostStepStats(process_index=process_index,
                                    process_count=process_count)
         self.live = live
+        # the last assembled HBM ledger (obs.memledger): the train and
+        # serve loops store it here so a pre-kill flight record carries
+        # the final bucket partition — the OOM forensics CLI's
+        # reconstruct-from-artifacts input
+        self.last_memledger: Optional[Dict[str, Any]] = None
 
         def _extra_state() -> Dict[str, Any]:
             # the flight-record extras: HBM watermarks, plus — on the
@@ -71,6 +79,8 @@ class PodObserver:
             # dict, obs.live), so a pre-kill dump says what the POD
             # looked like, not just this process
             out = dict(self.hbm.split()) if self.hbm is not None else {}
+            if self.last_memledger is not None:
+                out["memledger"] = self.last_memledger
             if live is not None:
                 snap = live.snapshot_fields()
                 if snap is not None:
@@ -134,6 +144,8 @@ class PodObserver:
             # in every timing record, None = not derived (parsers must
             # not key-error on degraded runs)
             return {"hbm_peak_bytes": None, "hbm_bytes_in_use": None,
+                    "hbm_bytes_reserved": None,
+                    "hbm_fragmentation_bytes": None,
                     "hbm_limit_bytes": None, "hbm_peak_fraction": None,
                     "hbm_source": "off"}
         self.hbm.sample()   # final watermark before the record is cut

@@ -46,6 +46,7 @@ class HbmSampler:
         self.period_s = float(period_s)
         self.peak_in_use = 0        # max over time of max over devices
         self.last_in_use = 0
+        self.last_reserved: Optional[int] = None  # allocator reservation
         self.limit_bytes: Optional[int] = None
         self.source = "none"        # memory_stats | rss | none
         self.samples = 0
@@ -66,6 +67,7 @@ class HbmSampler:
         mark. Never raises — a dead backend must not kill the thread."""
         in_use = 0
         peak_reported = 0
+        reserved = None
         got_stats = False
         try:
             import jax
@@ -80,6 +82,9 @@ class HbmSampler:
                 in_use = max(in_use, int(stats.get("bytes_in_use", 0)))
                 peak_reported = max(
                     peak_reported, int(stats.get("peak_bytes_in_use", 0)))
+                res = stats.get("bytes_reserved")
+                if res is not None:
+                    reserved = max(reserved or 0, int(res))
                 limit = stats.get("bytes_limit")
                 if limit:
                     self.limit_bytes = int(limit)
@@ -88,6 +93,7 @@ class HbmSampler:
         if got_stats:
             self.source = "memory_stats"
             self.last_in_use = in_use
+            self.last_reserved = reserved
             self.peak_in_use = max(self.peak_in_use, in_use, peak_reported)
         elif self.source != "memory_stats":
             # RSS fallback ONLY on backends that never reported device
@@ -107,8 +113,17 @@ class HbmSampler:
         frac = None
         if self.limit_bytes and self.peak_in_use:
             frac = round(self.peak_in_use / self.limit_bytes, 4)
+        # fragmentation: what the allocator holds beyond live buffers —
+        # reserved minus in-use, only on backends whose memory_stats
+        # report a reservation (RSS says nothing about the allocator)
+        frag = None
+        if self.last_reserved is not None \
+                and self.source == "memory_stats":
+            frag = max(0, self.last_reserved - self.last_in_use)
         return {"hbm_peak_bytes": self.peak_in_use or None,
                 "hbm_bytes_in_use": self.last_in_use or None,
+                "hbm_bytes_reserved": self.last_reserved,
+                "hbm_fragmentation_bytes": frag,
                 "hbm_limit_bytes": self.limit_bytes,
                 "hbm_peak_fraction": frac,
                 "hbm_source": self.source}

@@ -559,6 +559,26 @@ class LiveAggregator:
             self._pod["goodput_fraction"] = frac
             self.engine.observe("goodput", frac,
                                 step=self._pod.get("step"))
+        elif kind == "memledger":
+            # the run-end HBM ledger (obs.memledger.ledger_record):
+            # per-bucket bytes become the tpudist_hbm_bytes{bucket=...}
+            # gauge family and the headroom fraction is graded live
+            # against the TPUDIST_HBM_HEADROOM_MIN floor — an
+            # over-committed device alerts before the allocation spike
+            # that would kill it
+            ml = self._pod.setdefault("memledger", {})
+            for k in ("total_hbm_bytes", "headroom_fraction",
+                      "hbm_headroom_status", "exact", "mode",
+                      "watermark_source"):
+                if rec.get(k) is not None:
+                    ml[k] = rec[k]
+            for k in ("params", "opt_state", "slabs", "kv_pool",
+                      "program_temp", "headroom", "residue"):
+                if rec.get(f"{k}_bytes") is not None:
+                    ml.setdefault("buckets", {})[k] = rec[f"{k}_bytes"]
+            self.engine.observe("hbm_headroom",
+                                rec.get("headroom_fraction"),
+                                step=self._pod.get("step"))
         elif kind == "stall_dump":
             # the watchdog's last gasp: the worker MEASURED this many
             # seconds without step progress before dumping — observe it
@@ -858,6 +878,15 @@ _PROM_HELP = {
     "tpudist_goodput_fraction": "Attempt-local productive fraction of "
                                 "wall clock (run-end estimate; the "
                                 "cross-attempt ledger refines it).",
+    "tpudist_hbm_bytes": "Per-device HBM bytes per memory-ledger "
+                         "bucket (the partition sums to device HBM).",
+    "tpudist_hbm_total_bytes": "Device HBM size the memory ledger "
+                               "partitions.",
+    "tpudist_hbm_headroom_fraction": "Unattributed free fraction of "
+                                     "device HBM (obs.memledger).",
+    "tpudist_memledger_exact": "1 when the ledger's watermark "
+                               "reconciliation met the pinned "
+                               "tolerance.",
     "tpudist_ckpt_last_enqueue_ms": "Last checkpoint enqueue cost.",
     "tpudist_ckpt_drain_ms": "Run-total checkpoint drain cost.",
     "tpudist_host_step": "Per-host last step from its heartbeat.",
@@ -984,6 +1013,16 @@ def prometheus_text(status: Dict[str, Any]) -> str:
            [({}, pod.get("straggler_ratio"))])
     metric("tpudist_goodput_fraction",
            [({}, pod.get("goodput_fraction"))])
+    ml = pod.get("memledger") or {}
+    metric("tpudist_hbm_bytes",
+           [({"bucket": b}, (ml.get("buckets") or {}).get(b))
+            for b in ("params", "opt_state", "slabs", "kv_pool",
+                      "program_temp", "headroom", "residue")])
+    metric("tpudist_hbm_total_bytes", [({}, ml.get("total_hbm_bytes"))])
+    metric("tpudist_hbm_headroom_fraction",
+           [({}, ml.get("headroom_fraction"))])
+    metric("tpudist_memledger_exact",
+           [({}, (1 if ml.get("exact") else 0) if ml else None)])
     metric("tpudist_ckpt_last_enqueue_ms",
            [({}, pod.get("ckpt_last_enqueue_ms"))])
     metric("tpudist_ckpt_drain_ms", [({}, pod.get("ckpt_drain_ms"))])

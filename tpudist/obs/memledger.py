@@ -1,0 +1,717 @@
+"""HBM memory ledger: every device byte attributed to a named bucket.
+
+HBM is the binding resource for both halves of the framework — the
+paged KV serve lane and streamed staging exist precisely to live inside
+a fixed HBM budget — yet until this module the framework could only
+observe memory as an opaque watermark (:mod:`tpudist.obs.hbm` samples
+``memory_stats`` / RSS) and the staging resolver guessed with a
+``state_bytes x 4`` margin. Footprint is a property of the *compiled
+program* and of the model's own static buffers, so it should be READ,
+not sampled: ``compiled.memory_analysis()`` gives argument/output/temp/
+generated-code bytes per pinned program (train step/superstep, serve
+prefill, each decode-ladder rung, the speculative verify program), and
+the model gives its static buckets (params and optimizer state from
+``engine.state_bytes_per_device``, resident staged slabs from
+``sharding.plan_slabs``, the KV pool + page table from
+``PagedCacheSpec.bytes``).
+
+The ledger partitions one device's HBM EXACTLY — the same discipline as
+the devtime decomposition (PR 6), the goodput ledger (PR 10) and the
+shed ledger (PR 15) — into::
+
+    params / opt_state / slabs / kv_pool / program_temp
+    / headroom / residue        (sum == device HBM, by construction)
+
+``program_temp`` is the MAX across programs of temp + generated-code
+bytes (programs never run concurrently on one device, so peak scratch
+is the max, not the sum). ``residue`` reconciles the derived footprint
+against the measured :class:`~tpudist.obs.hbm.HbmSampler` watermark
+when the backend reports real device stats: it is what the model failed
+to attribute (allocator overhead, fragmentation, untracked buffers) —
+flagged ``exact=False`` past the pinned :data:`TOLERANCE`. ``headroom``
+is the honest remainder; a NEGATIVE headroom means the pod is
+over-committed and one allocation spike from ``RESOURCE_EXHAUSTED``,
+which is why the ``hbm_headroom`` rule's default floor of 0.0 breaches
+on it even with no opt-in.
+
+Four consumers:
+
+  * the train/serve loops log a ``kind=memledger`` record
+    (:func:`ledger_record`) the live aggregator turns into
+    ``tpudist_hbm_bytes{bucket=...}`` gauges and grades against
+    ``TPUDIST_HBM_HEADROOM_MIN``;
+  * :mod:`tpudist.obs.report` renders a jax-free "Memory" section with
+    the bucket table and delta-vs-baseline;
+  * OOM forensics: the flight recorder embeds the last ledger, and
+    ``python -m tpudist.obs.memledger --run-dir D`` reconstructs from
+    artifacts alone which bucket grew before a RESOURCE_EXHAUSTED death
+    and names the knob to turn (:data:`KNOBS`);
+  * feed-forward: ``config.resolve_staging_budget_bytes`` and the serve
+    allocator's admission bound accept the ledger's measured temp bytes
+    in place of the 4x heuristic (heuristic kept as fallback, choice
+    logged).
+
+jax-free by design (the offline-tooling contract shared with
+:mod:`tpudist.obs.report` and :mod:`tpudist.obs.goodput`): the CLI runs
+on the CI host or a laptop against scp'd artifacts.
+
+CLI::
+
+    python -m tpudist.obs.memledger --run-dir DIR \
+        [--out memledger.json] [--bench-out BENCH_MEMORY.json] \
+        [--prom-out memledger.prom] [--baseline OLD/memledger.json]
+    python -m tpudist.obs.memledger --drill --run-dir DIR   # scripted OOM
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from tpudist import rules as rules_lib
+
+MEMLEDGER_SCHEMA_VERSION = 1
+LEDGER_NAME = "memledger.json"
+
+# Partition exactness: the pinned tolerance (fraction of device HBM)
+# past which the watermark-reconciliation residue flags the ledger
+# inexact — the same ±1% discipline as devtime and goodput.
+TOLERANCE = 0.01
+
+SUCCESS = "success"     # mirrors tpudist.verdict vocabulary without the
+FAIL = "fail"           # import (same pattern as obs.goodput/obs.alerts)
+UNGATEABLE = "ungateable"
+
+# The headroom floor lives in tpudist.rules with every other gate
+# (TPUDIST_HBM_HEADROOM_MIN, resolved at call time); the alias is this
+# module's documented surface, like goodput's.
+HBM_HEADROOM_MIN = rules_lib.HBM_HEADROOM_MIN
+
+# Bucket names, display order. The first five are attributed; headroom
+# and residue close the partition (sum over BUCKETS == device HBM).
+BUCKETS = ("params", "opt_state", "slabs", "kv_pool", "program_temp",
+           "headroom", "residue")
+ATTRIBUTED = ("params", "opt_state", "slabs", "kv_pool", "program_temp")
+
+# Forensics: the knob that shrinks each growable bucket — what the CLI
+# prints after naming the guilty bucket, so an OOM post-mortem ends
+# with an action, not just a diagnosis.
+KNOBS = {
+    "params": "shard the model further (--fsdp-shard / --tensor-"
+              "parallel) or pick a smaller --model",
+    "opt_state": "optimizer state scales with params: shard further "
+                 "(--fsdp-shard) or reduce the model",
+    "slabs": "--staging-budget-mb (env TPUDIST_STAGING_BUDGET_MB): a "
+             "smaller budget streams more, smaller slabs",
+    "kv_pool": "--kv-pages / --kv-page-tokens (or fewer --slots): "
+               "shrink the paged KV pool and page table",
+    "program_temp": "--steps-per-dispatch (train superstep scratch) / "
+                    "the decode_k ladder and --speculate-k (serve "
+                    "scratch)",
+}
+
+
+def hbm_headroom_status(fraction: Optional[float],
+                        min_fraction: Optional[float] = None) -> str:
+    """Three-valued headroom verdict: UNGATEABLE with nothing derived
+    (a run with no ledger must not read as a headroom pass), else
+    SUCCESS/FAIL by whether the free fraction clears
+    ``TPUDIST_HBM_HEADROOM_MIN``. The default floor is 0.0, so only an
+    over-committed device (negative headroom) fails without opt-in —
+    how much slack a pod NEEDS is a capacity-planning choice."""
+    if fraction is None:
+        return UNGATEABLE
+    if min_fraction is None:
+        min_fraction = rules_lib.resolve("hbm_headroom")
+    return SUCCESS if fraction >= min_fraction else FAIL
+
+
+# ------------------------------------------------------------- the ledger
+
+
+def program_temp_bytes(programs: Optional[Dict[str, Dict[str, Any]]]
+                       ) -> Tuple[int, bool]:
+    """(peak scratch bytes, complete) across the pinned programs.
+
+    Programs never run concurrently on one device (the two-compiled-
+    programs discipline serializes them), so the resident scratch peak
+    is the MAX of each program's temp + generated-code bytes, not the
+    sum. ``complete`` is False when any program reported no analysis
+    (CPU builds may not implement memory planning) — the bucket then
+    under-counts and the ledger records the gap as a note, not a lie.
+    """
+    peak = 0
+    complete = True
+    for mem in (programs or {}).values():
+        if not mem:
+            complete = False
+            continue
+        peak = max(peak, int(mem.get("temp_bytes") or 0)
+                   + int(mem.get("generated_code_bytes") or 0))
+    return peak, complete
+
+
+def build_ledger(*, total_hbm_bytes: float,
+                 params_bytes: float = 0,
+                 opt_state_bytes: float = 0,
+                 slab_bytes: float = 0,
+                 kv_pool_bytes: float = 0,
+                 programs: Optional[Dict[str, Dict[str, Any]]] = None,
+                 watermark_bytes: Optional[float] = None,
+                 watermark_source: Optional[str] = None,
+                 mode: str = "train",
+                 run_id: Optional[str] = None,
+                 tolerance: float = TOLERANCE) -> Dict[str, Any]:
+    """Partition one device's HBM into the memory buckets.
+
+    All byte inputs are PER-DEVICE numbers (the engine's
+    ``state_bytes_per_device`` convention). The sum of all buckets
+    equals ``total_hbm_bytes`` EXACTLY by construction: ``residue`` is
+    the watermark-vs-derived reconciliation (zero when the watermark is
+    not a real device measurement — RSS on the CPU mesh says nothing
+    about a device partition) and ``headroom`` is the remainder.
+    ``exact`` certifies the reconciliation stayed inside the pinned
+    tolerance and nothing over-committed the device.
+    """
+    total = int(total_hbm_bytes)
+    if total <= 0:
+        raise ValueError(f"total_hbm_bytes must be > 0, got "
+                         f"{total_hbm_bytes!r} — the device HBM size is "
+                         f"the partition's spine (TPUDIST_HBM_BYTES "
+                         f"pins it on backends that report none)")
+    programs = dict(programs or {})
+    temp, complete = program_temp_bytes(programs)
+    buckets: Dict[str, int] = {
+        "params": int(params_bytes),
+        "opt_state": int(opt_state_bytes),
+        "slabs": int(slab_bytes),
+        "kv_pool": int(kv_pool_bytes),
+        "program_temp": temp,
+    }
+    derived = sum(buckets.values())
+
+    exact = True
+    problems: List[str] = []
+    notes: List[str] = []
+    for k, v in buckets.items():
+        if v < 0:
+            exact = False
+            problems.append(f"bucket {k} is negative ({v} bytes) — a "
+                            f"byte count can never be")
+            buckets[k] = 0
+    derived = sum(buckets.values())
+
+    # residue: what the measured watermark saw that the model did not
+    # attribute (allocator overhead, fragmentation, untracked buffers)
+    # — only a REAL device measurement reconciles; an RSS fallback
+    # watermark measures the host, not the device partition
+    reconciled = watermark_source == "memory_stats" \
+        and watermark_bytes is not None
+    residue = int(watermark_bytes) - derived if reconciled else 0
+    if reconciled and abs(residue) > tolerance * total:
+        exact = False
+        if residue > 0:
+            problems.append(
+                f"measured watermark exceeds the derived footprint by "
+                f"{residue} bytes ({residue / total:.1%} of HBM) — "
+                f"unattributed allocations")
+        else:
+            problems.append(
+                f"derived footprint exceeds the measured watermark by "
+                f"{-residue} bytes ({-residue / total:.1%} of HBM) — "
+                f"double counting or never-materialized buffers")
+    buckets["residue"] = residue
+    buckets["headroom"] = total - derived - residue
+    if buckets["headroom"] < 0:
+        # over-committed: not an accounting error (the partition is
+        # still exact — headroom honestly negative), but the pod is one
+        # allocation spike from RESOURCE_EXHAUSTED; the headroom rule's
+        # default 0.0 floor breaches on exactly this
+        notes.append(f"device over-committed by {-buckets['headroom']} "
+                     f"bytes — headroom is negative")
+    if not complete:
+        missing = sorted(k for k, v in programs.items() if not v)
+        notes.append("no memory_analysis for program(s) "
+                     f"{', '.join(missing)} — program_temp under-counts "
+                     f"(backend does not report memory planning)")
+
+    frac = round(buckets["headroom"] / total, 6)
+    return {
+        "schema": MEMLEDGER_SCHEMA_VERSION,
+        "mode": mode,
+        "run_id": run_id,
+        "total_hbm_bytes": total,
+        "buckets": {k: int(buckets[k]) for k in BUCKETS},
+        "programs": {k: dict(v or {}) for k, v in programs.items()},
+        "program_temp_complete": complete,
+        "watermark_bytes": (int(watermark_bytes)
+                            if watermark_bytes is not None else None),
+        "watermark_source": watermark_source,
+        "headroom_fraction": frac,
+        "headroom_status": hbm_headroom_status(frac),
+        "headroom_min": rules_lib.resolve("hbm_headroom"),
+        "exact": exact,
+        "tolerance": tolerance,
+        "problems": problems,
+        "notes": notes,
+    }
+
+
+def ledger_record(ledger: Dict[str, Any]) -> Dict[str, Any]:
+    """The ledger as the flat ``kind=memledger`` metrics record: one
+    ``<bucket>_bytes`` field per bucket plus the headroom grade — the
+    shape the live aggregator ingests and the report CLI reads back."""
+    b = ledger.get("buckets") or {}
+    rec: Dict[str, Any] = {
+        "total_hbm_bytes": ledger.get("total_hbm_bytes"),
+        "headroom_fraction": ledger.get("headroom_fraction"),
+        "hbm_headroom_status": ledger.get("headroom_status"),
+        "watermark_bytes": ledger.get("watermark_bytes"),
+        "watermark_source": ledger.get("watermark_source"),
+        "program_temp_complete": ledger.get("program_temp_complete"),
+        "exact": ledger.get("exact"),
+        "mode": ledger.get("mode"),
+    }
+    for k in BUCKETS:
+        rec[f"{k}_bytes"] = b.get(k)
+    return rec
+
+
+def from_record(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """A minimal ledger dict back out of a flat ``kind=memledger``
+    record (the forensics path reads history from metrics.jsonl). None
+    when the record carries no bucket bytes at all."""
+    buckets = {}
+    for k in BUCKETS:
+        v = rec.get(f"{k}_bytes")
+        if isinstance(v, (int, float)):
+            buckets[k] = int(v)
+    if not buckets:
+        return None
+    return {
+        "schema": MEMLEDGER_SCHEMA_VERSION,
+        "mode": rec.get("mode"),
+        "run_id": rec.get("run_id"),
+        "total_hbm_bytes": rec.get("total_hbm_bytes"),
+        "buckets": {k: buckets.get(k, 0) for k in BUCKETS},
+        "programs": {},
+        "program_temp_complete": rec.get("program_temp_complete"),
+        "watermark_bytes": rec.get("watermark_bytes"),
+        "watermark_source": rec.get("watermark_source"),
+        "headroom_fraction": rec.get("headroom_fraction"),
+        "headroom_status": rec.get("hbm_headroom_status"),
+        "exact": rec.get("exact"),
+        "problems": [],
+        "notes": [],
+    }
+
+
+# ----------------------------------------------------------- forensics
+
+
+def load_jsonl(path: str) -> List[Dict[str, Any]]:
+    out = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue    # a torn tail line is not evidence
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def _find_flightrecs(run_dir: str) -> List[str]:
+    paths = set(glob.glob(os.path.join(run_dir, "**", "flightrec.worker*"),
+                          recursive=True))
+    return sorted(p for p in paths if not p.endswith(".tmp"))
+
+
+def collect_ledgers(run_dir: str) -> List[Tuple[str, Dict[str, Any]]]:
+    """Every ledger snapshot the run left behind, in evidence order:
+    ``kind=memledger`` metrics records first (the run's own timeline),
+    then the ``memledger.json`` artifact (the run-end state), then any
+    flight-record-embedded ledger LAST — a flight record is dumped at
+    death, so its ledger is the final pre-mortem state. Returns
+    ``(source, ledger)`` pairs."""
+    out: List[Tuple[str, Dict[str, Any]]] = []
+    mpaths = set(glob.glob(os.path.join(run_dir, "metrics.jsonl")))
+    mpaths |= set(glob.glob(os.path.join(run_dir, "*", "metrics.jsonl")))
+    for mp in sorted(mpaths):
+        for rec in load_jsonl(mp):
+            if rec.get("kind") != "memledger":
+                continue
+            led = from_record(rec)
+            if led is not None:
+                out.append((os.path.basename(mp), led))
+    apath = os.path.join(run_dir, LEDGER_NAME)
+    if os.path.exists(apath):
+        try:
+            with open(apath) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if isinstance(doc, dict) and isinstance(doc.get("buckets"), dict):
+            out.append((LEDGER_NAME, doc))
+    for fp in _find_flightrecs(run_dir):
+        try:
+            with open(fp) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        led = (payload.get("extra") or {}).get("memledger") \
+            if isinstance(payload, dict) else None
+        if isinstance(led, dict) and isinstance(led.get("buckets"), dict):
+            out.append((os.path.basename(fp), led))
+    return out
+
+
+def find_oom(run_dir: str) -> Optional[Dict[str, Any]]:
+    """The death evidence: the first flight record whose ``reason``
+    mentions RESOURCE_EXHAUSTED (XLA's OOM vocabulary) — returns
+    ``{"source", "reason"}`` or None for a run that did not die of
+    memory."""
+    for fp in _find_flightrecs(run_dir):
+        try:
+            with open(fp) as f:
+                payload = json.load(f)
+        except (OSError, ValueError):
+            continue
+        reason = str(payload.get("reason") or "")
+        if "RESOURCE_EXHAUSTED" in reason.upper():
+            return {"source": os.path.basename(fp), "reason": reason}
+    return None
+
+
+def diagnose(run_dir: str) -> Dict[str, Any]:
+    """OOM forensics from artifacts alone: which bucket grew before the
+    death, and which knob turns it. Compares the earliest ledger
+    snapshot (the baseline) against the latest (the flight-record-
+    embedded pre-mortem state when one exists); with a single snapshot
+    the largest attributed bucket is named instead — a one-snapshot
+    post-mortem can still say where the bytes went."""
+    ledgers = collect_ledgers(run_dir)
+    oom = find_oom(run_dir)
+    if not ledgers:
+        return {"oom": oom is not None,
+                "reason": oom["reason"] if oom else None,
+                "guilty_bucket": None, "knob": None, "growth": {},
+                "baseline_source": None, "death_source": None,
+                "ledgers": 0}
+    death_source, death = ledgers[-1]
+    base_source, base = ledgers[0]
+    growth: Dict[str, int] = {}
+    guilty = None
+    if len(ledgers) >= 2:
+        db, bb = death.get("buckets") or {}, base.get("buckets") or {}
+        for k in ATTRIBUTED:
+            d = int(db.get(k) or 0) - int(bb.get(k) or 0)
+            if d:
+                growth[k] = d
+        grew = {k: v for k, v in growth.items() if v > 0}
+        if grew:
+            guilty = max(grew, key=lambda k: grew[k])
+    if guilty is None:
+        db = death.get("buckets") or {}
+        sized = {k: int(db.get(k) or 0) for k in ATTRIBUTED}
+        if any(sized.values()):
+            guilty = max(sized, key=lambda k: sized[k])
+    return {"oom": oom is not None,
+            "reason": oom["reason"] if oom else None,
+            "guilty_bucket": guilty,
+            "knob": KNOBS.get(guilty) if guilty else None,
+            "growth": growth,
+            "baseline_source": base_source if len(ledgers) >= 2 else None,
+            "death_source": death_source,
+            "ledgers": len(ledgers)}
+
+
+def _mib(b: Any) -> str:
+    return f"{int(b) / 2**20:.1f} MiB" if isinstance(b, (int, float)) \
+        else "?"
+
+
+def forensics_lines(diag: Dict[str, Any]) -> List[str]:
+    lines: List[str] = []
+    if diag.get("oom"):
+        lines.append(f"tpudist: memledger OOM death detected "
+                     f"({diag.get('death_source')}): "
+                     f"{diag.get('reason')}")
+    guilty = diag.get("guilty_bucket")
+    if guilty is None:
+        if diag.get("oom"):
+            lines.append("tpudist: memledger forensics: no ledger "
+                         "snapshot survived — cannot name a bucket")
+        return lines
+    delta = (diag.get("growth") or {}).get(guilty)
+    if delta is not None and diag.get("baseline_source"):
+        lines.append(
+            f"tpudist: memledger guilty bucket: {guilty} grew "
+            f"{_mib(delta)} between {diag['baseline_source']} and "
+            f"{diag['death_source']}")
+    else:
+        lines.append(
+            f"tpudist: memledger guilty bucket: {guilty} (largest "
+            f"attributed bucket at {diag['death_source']})")
+    lines.append(f"tpudist: memledger knob: {KNOBS[guilty]}")
+    return lines
+
+
+# --------------------------------------------------- prometheus textfile
+
+
+_PROM_HELP = {
+    "tpudist_memledger_info": "Ledger identity (labels carry mode and "
+                              "exactness).",
+    "tpudist_hbm_bytes": "Per-device HBM bytes per ledger bucket (the "
+                         "partition sums to device HBM).",
+    "tpudist_hbm_total_bytes": "Device HBM size the ledger partitions.",
+    "tpudist_hbm_headroom_fraction": "Unattributed free fraction of "
+                                     "device HBM.",
+    "tpudist_memledger_exact": "1 when the watermark reconciliation "
+                               "met the pinned tolerance.",
+}
+
+
+def prometheus_text(ledger: Dict[str, Any]) -> str:
+    """The ledger as Prometheus text exposition (0.0.4), rendered with
+    the SAME escaping/number formatting as the live exporter so the
+    offline ``tpudist_hbm_bytes`` family reads identically to the live
+    gauges (the consumer-parity pin)."""
+    from tpudist.obs.live import _prom_escape, _prom_num
+    out: List[str] = []
+
+    def metric(name, samples, mtype="gauge"):
+        rows = [(lbl, v) for lbl, v in samples if v is not None]
+        if not rows:
+            return
+        out.append(f"# HELP {name} {_PROM_HELP[name]}")
+        out.append(f"# TYPE {name} {mtype}")
+        for lbl, v in rows:
+            label_s = ",".join(f'{k}="{_prom_escape(x)}"'
+                               for k, x in lbl.items())
+            out.append(f"{name}{{{label_s}}} {_prom_num(v)}"
+                       if label_s else f"{name} {_prom_num(v)}")
+
+    metric("tpudist_memledger_info",
+           [({"mode": ledger.get("mode") or "",
+              "exact": str(bool(ledger.get("exact"))).lower()}, 1)])
+    metric("tpudist_hbm_bytes",
+           [({"bucket": k}, (ledger.get("buckets") or {}).get(k))
+            for k in BUCKETS])
+    metric("tpudist_hbm_total_bytes",
+           [({}, ledger.get("total_hbm_bytes"))])
+    metric("tpudist_hbm_headroom_fraction",
+           [({}, ledger.get("headroom_fraction"))])
+    metric("tpudist_memledger_exact",
+           [({}, 1 if ledger.get("exact") else 0)])
+    return "\n".join(out) + "\n"
+
+
+def bench_artifact(ledger: Dict[str, Any],
+                   extra_detail: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """BENCH_MEMORY-style artifact on the shared BENCH_* harness shape:
+    the headline value is the headroom fraction, the detail the full
+    ledger (plus any sweep rows the bench driver appends)."""
+    detail: Dict[str, Any] = {"ledger": ledger}
+    if extra_detail:
+        detail.update(extra_detail)
+    return {
+        "metric": "hbm_headroom_fraction",
+        "value": ledger.get("headroom_fraction"),
+        "unit": "unattributed free fraction of device HBM",
+        "detail": detail,
+    }
+
+
+# ----------------------------------------------------------- the drill
+
+
+DRILL_REASON = ("RESOURCE_EXHAUSTED: scripted OOM drill — allocation "
+                "would exceed device HBM")
+
+
+def run_drill(run_dir: str, *, grow: str = "slabs") -> str:
+    """The scripted OOM drill: take the run directory's REAL ledger (a
+    prior train/serve run wrote it), synthesize the pre-mortem state an
+    OOM'ing run would have reached — the ``grow`` bucket inflated past
+    the device's remaining headroom, partition kept exact — and dump a
+    flight record with that ledger embedded and a RESOURCE_EXHAUSTED
+    reason, exactly the artifact the heartbeat watchdog leaves behind.
+    The forensics path must then reconstruct the guilty bucket from the
+    artifacts alone. Returns the flight-record path. jax-free."""
+    from tpudist.obs import flightrec
+
+    if grow not in ATTRIBUTED:
+        raise ValueError(f"--drill-grow must be one of {ATTRIBUTED}, "
+                         f"got {grow!r}")
+    apath = os.path.join(run_dir, LEDGER_NAME)
+    try:
+        with open(apath) as f:
+            base = json.load(f)
+    except (OSError, ValueError):
+        raise RuntimeError(
+            f"no baseline ledger at {apath} — run the train/serve CLI "
+            f"into --run-dir first (the drill grows a REAL ledger)")
+    buckets = dict(base.get("buckets") or {})
+    total = int(base.get("total_hbm_bytes") or 0)
+    if total <= 0:
+        raise RuntimeError(f"baseline ledger at {apath} carries no "
+                           f"total_hbm_bytes")
+    # grow the bucket past everything the device had left: headroom
+    # goes negative by one page-ish margin — the allocation that died
+    delta = max(int(buckets.get("headroom") or 0), 0) + (1 << 20)
+    death = {k: dict(v) if isinstance(v, dict) else v
+             for k, v in base.items()}
+    death["buckets"] = dict(buckets)
+    death["buckets"][grow] = int(buckets.get(grow) or 0) + delta
+    death["buckets"]["headroom"] = int(buckets.get("headroom") or 0) \
+        - delta
+    frac = round(death["buckets"]["headroom"] / total, 6)
+    death["headroom_fraction"] = frac
+    death["headroom_status"] = hbm_headroom_status(frac)
+    death["notes"] = list(base.get("notes") or []) + [
+        f"scripted OOM drill grew {grow} by {delta} bytes"]
+    path = os.path.join(run_dir, "flightrec.worker0")
+    flightrec.dump_flight_record(
+        path, reason=DRILL_REASON,
+        progress={"drill": "memledger-oom", "grew": grow},
+        extra={"memledger": death})
+    return path
+
+
+# -------------------------------------------------------------- the CLI
+
+
+def _summary_lines(ledger: Dict[str, Any]) -> List[str]:
+    b = ledger.get("buckets") or {}
+    frac = ledger.get("headroom_fraction")
+    lines = [
+        f"tpudist: memledger [{ledger.get('mode')}] hbm_headroom "
+        f"{ledger.get('headroom_status')}: "
+        + (f"{100 * frac:.1f}% free" if frac is not None
+           else "nothing derived")
+        + f" of {_mib(ledger.get('total_hbm_bytes'))} device HBM",
+        "tpudist: memledger buckets: " + ", ".join(
+            f"{k} {_mib(b.get(k, 0))}" for k in BUCKETS),
+        f"tpudist: memledger partition "
+        f"{'exact' if ledger.get('exact') else 'INEXACT'} "
+        f"(tolerance {ledger.get('tolerance', TOLERANCE):.0%})",
+    ]
+    for p in ledger.get("problems") or []:
+        lines.append(f"tpudist: memledger problem: {p}")
+    for n in ledger.get("notes") or []:
+        lines.append(f"tpudist: memledger note: {n}")
+    return lines
+
+
+def _atomic_write(path: str, payload: str) -> None:
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w") as f:
+        f.write(payload)
+    os.replace(tmp, path)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m tpudist.obs.memledger",
+        description="per-device HBM ledger + OOM forensics from run "
+                    "artifacts (memledger.json, kind=memledger "
+                    "records, flight records) — jax-free")
+    p.add_argument("--run-dir", type=str, default=".",
+                   help="directory holding memledger.json, "
+                        "metrics.jsonl and/or flightrec.worker* dumps")
+    p.add_argument("--out", type=str, default=None,
+                   help=f"write the latest ledger back as JSON "
+                        f"(default: <run-dir>/{LEDGER_NAME} only when "
+                        f"absent — never clobbers the run's own "
+                        f"artifact)")
+    p.add_argument("--bench-out", type=str, default=None,
+                   help="also write a BENCH_MEMORY-shaped artifact "
+                        "(headline = headroom fraction)")
+    p.add_argument("--prom-out", type=str, default=None,
+                   help="also write tpudist_hbm_* gauges as a "
+                        "Prometheus textfile-collector file")
+    p.add_argument("--baseline", type=str, default=None,
+                   help="a prior run's memledger.json: print the "
+                        "per-bucket delta against it")
+    p.add_argument("--drill", action="store_true",
+                   help="first run the scripted OOM drill into "
+                        "--run-dir (grows a bucket of the dir's REAL "
+                        "ledger past headroom and dumps the flight "
+                        "record an OOM death leaves), then run the "
+                        "forensics over it")
+    p.add_argument("--drill-grow", type=str, default="slabs",
+                   choices=sorted(ATTRIBUTED),
+                   help="which bucket the drill grows (default slabs)")
+    args = p.parse_args(argv)
+
+    if args.drill:
+        run_drill(args.run_dir, grow=args.drill_grow)
+
+    ledgers = collect_ledgers(args.run_dir)
+    if not ledgers:
+        print(f"tpudist.obs.memledger: no ledger evidence under "
+              f"{args.run_dir} — the train/serve CLIs write "
+              f"{LEDGER_NAME} and kind=memledger records",
+              file=sys.stderr)
+        return 2
+    source, ledger = ledgers[-1]
+    print(f"tpudist: memledger latest snapshot from {source} "
+          f"({len(ledgers)} snapshot(s))")
+    for line in _summary_lines(ledger):
+        print(line)
+
+    if args.baseline:
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except (OSError, ValueError):
+            print(f"tpudist.obs.memledger: unreadable --baseline "
+                  f"{args.baseline}", file=sys.stderr)
+            return 2
+        bb = baseline.get("buckets") or {}
+        lb = ledger.get("buckets") or {}
+        deltas = ", ".join(
+            f"{k} {'+' if int(lb.get(k) or 0) >= int(bb.get(k) or 0) else '-'}"
+            f"{_mib(abs(int(lb.get(k) or 0) - int(bb.get(k) or 0)))}"
+            for k in BUCKETS)
+        print(f"tpudist: memledger delta vs baseline: {deltas}")
+
+    diag = diagnose(args.run_dir)
+    for line in forensics_lines(diag):
+        print(line)
+
+    out = args.out
+    if out is None:
+        default = os.path.join(args.run_dir, LEDGER_NAME)
+        out = default if not os.path.exists(default) else None
+    if out:
+        _atomic_write(out, json.dumps(ledger, indent=1))
+    if args.bench_out:
+        _atomic_write(args.bench_out,
+                      json.dumps(bench_artifact(ledger), indent=1))
+    if args.prom_out:
+        _atomic_write(args.prom_out, prometheus_text(ledger))
+    # the headroom grade is advisory (opt-in floor); a broken PARTITION
+    # is a real failure — the whole point is exact accounting
+    return 0 if ledger.get("exact") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -44,6 +44,7 @@ from tpudist import rules as rules_lib
 from tpudist.obs import devtime as devtime_mod
 from tpudist.obs import goodput as goodput_mod
 from tpudist.obs import live as live_mod
+from tpudist.obs import memledger as memledger_mod
 from tpudist.serve import flight as flight_mod
 from tpudist.serve import slo as slo_mod
 
@@ -63,7 +64,11 @@ from tpudist.serve import slo as slo_mod
 # kv_pages_total / kv_pages_used_peak / kv_shared_refs,
 # spec_accept_rate + the spec_accept gate, speculate_k,
 # shared_prefix_len, active_slots_peak, verify_compiles).
-REPORT_SCHEMA_VERSION = 7
+# Schema 8: adds the "memory" section (per-device HBM ledger from
+# tpudist.obs.memledger — exact params/opt_state/slabs/kv_pool/
+# program_temp/headroom/residue partition, the hbm_headroom grade, and
+# the per-bucket delta against a baseline's memory section).
+REPORT_SCHEMA_VERSION = 8
 
 # Artifact schemas this reader KNOWS. A newer number is a warning, not
 # a failure: a requeue loop can scatter attempts across tpudist
@@ -77,6 +82,7 @@ KNOWN_ARTIFACT_SCHEMAS = {
     "trace": 1,
     "alerts": live_mod.LIVE_SCHEMA_VERSION,
     "goodput": goodput_mod.GOODPUT_SCHEMA_VERSION,
+    "memledger": memledger_mod.MEMLEDGER_SCHEMA_VERSION,
     "baseline": REPORT_SCHEMA_VERSION,
 }
 
@@ -815,6 +821,67 @@ def goodput_section(metrics: List[Dict[str, Any]],
     }
 
 
+def _find_memory_buckets(doc: Any) -> Optional[Dict[str, Any]]:
+    """Dig a per-bucket byte map out of a baseline document: a raw
+    memledger.json (top-level ``buckets``) or a prior run_report's
+    memory section."""
+    if not isinstance(doc, dict):
+        return None
+    for path in (("buckets",), ("memory", "buckets")):
+        cur: Any = doc
+        for k in path:
+            cur = cur.get(k) if isinstance(cur, dict) else None
+        if isinstance(cur, dict) and cur:
+            return cur
+    return None
+
+
+def memory_section(metrics: List[Dict[str, Any]],
+                   ledger: Optional[Dict[str, Any]] = None,
+                   baseline: Optional[Dict] = None) -> Dict[str, Any]:
+    """The HBM-ledger slice of the report (tpudist.obs.memledger): the
+    exact per-bucket partition of one device's HBM, graded against the
+    shared ``hbm_headroom`` floor at fold time (env read now — same
+    re-grade discipline as the goodput section), plus the per-bucket
+    delta when the baseline carries a memory section of its own. A run
+    with neither a ``memledger.json`` artifact nor a ``kind=memledger``
+    record folds to ``{"enabled": False}`` — UNGATEABLE, never a crash
+    (older run dirs predate the ledger)."""
+    if ledger is None:
+        recs = [r for r in metrics if r.get("kind") == "memledger"]
+        if recs:
+            ledger = memledger_mod.from_record(recs[-1])
+    if not ledger:
+        return {"enabled": False, "status": UNGATEABLE}
+    frac = ledger.get("headroom_fraction")
+    buckets = {k: (ledger.get("buckets") or {}).get(k)
+               for k in memledger_mod.BUCKETS}
+    sec: Dict[str, Any] = {
+        "enabled": True,
+        "status": memledger_mod.hbm_headroom_status(frac),
+        "headroom_fraction": frac,
+        "min_fraction": rules_lib.resolve("hbm_headroom"),
+        "mode": ledger.get("mode"),
+        "total_hbm_bytes": ledger.get("total_hbm_bytes"),
+        "buckets": buckets,
+        "watermark_bytes": ledger.get("watermark_bytes"),
+        "watermark_source": ledger.get("watermark_source"),
+        "program_temp_complete": ledger.get("program_temp_complete"),
+        "programs": sorted((ledger.get("programs") or {}).keys()),
+        "exact": ledger.get("exact"),
+        "problems": ledger.get("problems") or [],
+        "notes": ledger.get("notes") or [],
+    }
+    base_buckets = _find_memory_buckets(baseline)
+    if base_buckets:
+        sec["bucket_delta_bytes"] = {
+            k: int(buckets.get(k) or 0) - int(base_buckets.get(k) or 0)
+            for k in memledger_mod.BUCKETS
+            if buckets.get(k) is not None
+            or base_buckets.get(k) is not None}
+    return sec
+
+
 def _find_serve_tps(doc: Any) -> Optional[float]:
     """Dig a serve tokens/s/chip baseline out of a document: a
     BENCH_SERVE.json (top-level ``value`` under the serve metric name),
@@ -861,7 +928,8 @@ def build_report(metrics: List[Dict[str, Any]],
                  regress_min: Optional[float] = None,
                  collectives: Optional[Dict] = None,
                  alert_history: Optional[List[Dict]] = None,
-                 goodput: Optional[Dict] = None
+                 goodput: Optional[Dict] = None,
+                 memledger: Optional[Dict] = None
                  ) -> Dict[str, Any]:
     if regress_min is None:
         # the shared rules table (same env knob, read at call time, as
@@ -888,6 +956,7 @@ def build_report(metrics: List[Dict[str, Any]],
     serving = serving_section(metrics, baseline)
     flights = flights_section(metrics, trace_doc)
     goodput_sec = goodput_section(metrics, goodput)
+    memory = memory_section(metrics, memledger, baseline)
     # the correlation id: every metrics record carries it (the train
     # CLI stamps MetricsLogger.extra); older artifacts fall back to the
     # trace metadata
@@ -958,6 +1027,7 @@ def build_report(metrics: List[Dict[str, Any]],
         "serving": serving,
         "flights": flights,
         "goodput": goodput_sec,
+        "memory": memory,
         "alerts": alerts,
         "verdict": verdict,
     }
@@ -1230,6 +1300,50 @@ def to_markdown(report: Dict[str, Any]) -> str:
             lines.append(f"- ⚠️ {p}")
         if gp.get("problems"):
             lines.append("")
+    mem = r.get("memory") or {}
+    if mem.get("enabled"):
+        frac = mem.get("headroom_fraction")
+        total = mem.get("total_hbm_bytes") or 0
+        lines += ["## Memory (per-device HBM ledger)", "",
+                  f"**hbm_headroom_status: {mem['status']}** — "
+                  + (f"{100 * frac:.1f}%" if frac is not None else "—")
+                  + f" of {total / 2**20:.0f} MiB device HBM "
+                    f"unattributed ({mem.get('mode')} lane, floor "
+                    f"{100 * (mem.get('min_fraction') or 0):.0f}%)"
+                  + f" · partition "
+                  + ("exact" if mem.get("exact") else "**INEXACT**"), ""]
+        deltas = mem.get("bucket_delta_bytes") or {}
+        has_delta = bool(deltas)
+        lines += ["| bucket | MiB | % of HBM |"
+                  + (" Δ vs baseline MiB |" if has_delta else ""),
+                  "|---|---|---|" + ("---|" if has_delta else "")]
+        for b in memledger_mod.BUCKETS:
+            v = (mem.get("buckets") or {}).get(b)
+            row = (f"| {b} | "
+                   + (f"{v / 2**20:.1f}" if v is not None else "—")
+                   + " | "
+                   + (f"{100 * v / total:.1f}"
+                      if v is not None and total else "—") + " |")
+            if has_delta:
+                d = deltas.get(b)
+                row += (f" {d / 2**20:+.1f} |" if d is not None
+                        else " — |")
+            lines.append(row)
+        lines.append("")
+        if mem.get("watermark_bytes") is not None:
+            lines += [f"- measured watermark: "
+                      f"{mem['watermark_bytes'] / 2**20:.1f} MiB "
+                      f"({mem.get('watermark_source')})"]
+        if mem.get("programs"):
+            lines += ["- programs: " + ", ".join(mem["programs"])
+                      + ("" if mem.get("program_temp_complete")
+                         else " (some without memory_analysis — "
+                              "program_temp under-counts)")]
+        for p in mem.get("problems") or []:
+            lines.append(f"- ⚠️ {p}")
+        for n in mem.get("notes") or []:
+            lines.append(f"- {n}")
+        lines.append("")
     al = r.get("alerts") or {}
     if al.get("enabled"):
         lines += ["## Alerts (live telemetry)", ""]
@@ -1313,6 +1427,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                         "in <run-dir> — the cross-attempt goodput "
                         "ledger is built here and folded into the "
                         "Goodput section")
+    p.add_argument("--memledger", type=str, default=None,
+                   help="memledger.json (the train/serve CLIs write it, "
+                        "python -m tpudist.obs.memledger rebuilds it) "
+                        "for the Memory section (default: <run-dir>/"
+                        "memledger.json when present; a kind=memledger "
+                        "record is the in-stream fallback)")
     p.add_argument("--regress-min", type=float, default=None,
                    help=f"regression floor as a fraction of baseline "
                         f"steps/s (default $TPUDIST_REGRESS_MIN, else "
@@ -1409,11 +1529,27 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             goodput_doc = goodput_mod.build_from_dir(
                 run_dir, attempts_path=attempts_path)
 
+    # the memory ledger: an explicit --memledger must exist; the
+    # discovered <run-dir>/memledger.json is optional — run dirs from
+    # before the ledger still fold (the section reads UNGATEABLE)
+    memledger_doc = None
+    ml_path = args.memledger or os.path.join(run_dir,
+                                             memledger_mod.LEDGER_NAME)
+    if args.memledger and not os.path.exists(ml_path):
+        print(f"tpudist.obs.report: missing memledger file {ml_path}",
+              file=sys.stderr)
+        return 2
+    if os.path.exists(ml_path):
+        with open(ml_path) as f:
+            memledger_doc = json.load(f)
+        warn_newer_schema(memledger_doc, "memledger")
+
     report = build_report(metrics, trace_doc, baseline=baseline,
                           regress_min=args.regress_min,
                           collectives=collectives,
                           alert_history=alert_history,
-                          goodput=goodput_doc)
+                          goodput=goodput_doc,
+                          memledger=memledger_doc)
     out_json = args.out_json or os.path.join(run_dir, "run_report.json")
     out_md = args.out_md or os.path.join(run_dir, "run_report.md")
     for path, payload in ((out_json, json.dumps(report, indent=1)),

@@ -89,6 +89,18 @@ FLIGHT_DECOMP_TOL_S = 5e-6  # serve: max |ttft - (queue+prefill)| (s)
 # and production deployments tighten it via the env override.
 GOODPUT_MIN = 0.5           # obs.goodput.goodput_status
 
+# HBM headroom (tpudist.obs.memledger): the unattributed free fraction
+# of device HBM after the ledger's static buckets (params, opt state,
+# staged slabs, KV pool) and the compiled programs' peak temp are
+# carved out. The default floor is 0.0 — like SPEC_ACCEPT_MIN, the rule
+# never breaches unless a deployment opts in: how much headroom a pod
+# NEEDS is a capacity-planning choice (fragmentation slack, burst
+# admission, future growth), not a universal constant, and a fresh
+# checkout must not flag every snug-but-working configuration. CI lanes
+# and production pods pin their floor via the env override, and a
+# breach means the next allocation spike is an OOM, not a slowdown.
+HBM_HEADROOM_MIN = 0.0      # obs.memledger.hbm_headroom_status
+
 
 @dataclass(frozen=True)
 class Threshold:
@@ -217,6 +229,17 @@ THRESHOLDS: Tuple[Threshold, ...] = (
         description="below this the pod burns its wall-clock on "
                     "compile, exposed transfer, lost progress and "
                     "requeue gaps instead of training"),
+    Threshold(
+        name="hbm_headroom", env="TPUDIST_HBM_HEADROOM_MIN",
+        default=HBM_HEADROOM_MIN, sense="min", alert=True,
+        observable="unattributed free fraction of device HBM after the "
+                   "ledger's buckets (params, opt state, slabs, KV "
+                   "pool, program temp) are carved out",
+        description="below the opted-in floor the pod is one "
+                    "allocation spike from RESOURCE_EXHAUSTED — the "
+                    "ledger names which bucket to shrink; off by "
+                    "default (floor 0.0) since needed headroom is a "
+                    "capacity-planning choice"),
 )
 
 ALERT_RULES: Tuple[Threshold, ...] = tuple(

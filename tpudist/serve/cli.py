@@ -385,6 +385,29 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
     with trace_lib.span("serve_warmup", cat="serve"):
         engine.warmup(params)
 
+    # program memory (obs.memledger): warmup just compiled every pinned
+    # program, so their memory_analysis is readable off the request
+    # clock. For the paged plane it also feeds the allocator's memory
+    # bound: admission maps only the pages device HBM can afford beside
+    # the params and the programs' MEASURED scratch (falling back to the
+    # 4x-params heuristic on backends without memory planning — the
+    # choice is logged, and a shrunk cap backpressures at admission
+    # instead of dying in RESOURCE_EXHAUSTED)
+    from tpudist import engine as engine_lib
+    from tpudist.obs import memledger as memledger_lib
+    program_mem = engine.program_memory()
+    params_bytes = engine_lib.state_bytes_per_device(params)
+    hbm_bytes = int(engine_lib._device_hbm_bytes())
+    if getattr(engine, "paged", False):
+        temp, temp_complete = memledger_lib.program_temp_bytes(
+            program_mem)
+        cap = engine.alloc.set_memory_bound(
+            hbm_bytes=hbm_bytes, params_bytes=params_bytes,
+            program_temp_bytes=temp if temp_complete else None)
+        log0(f"tpudist: serve kv memory bound "
+             f"({engine.alloc.bound_source}): {cap}/{engine.spec.pages} "
+             f"pages mappable in {hbm_bytes / 2**20:.0f} MB HBM")
+
     prefix_len = max(args.shared_prefix, 0)
     shared_prefix = (sched.shared_prefix_tokens(
         min(prefix_len, args.prompt_pad), args.vocab_size, args.seed)
@@ -463,6 +486,33 @@ def run(args: argparse.Namespace) -> Dict[str, Any]:
                 **{k: v for k, v in summary.items()
                    if k not in ("results", "alert_events", "thresholds")})
     metrics.flush()
+
+    # the serve lane's HBM ledger (obs.memledger): params + KV pool
+    # (paged: pool pages incl. the trash page + page table — the
+    # PagedCacheSpec.bytes number the bench lane reports) + the pinned
+    # programs' scratch, partitioned exactly against device HBM and
+    # persisted as <save-dir>/memledger.json for the forensics CLI and
+    # the next run's feed-forward margin. Advisory: never fails serve.
+    try:
+        ledger = memledger_lib.build_ledger(
+            total_hbm_bytes=hbm_bytes, params_bytes=params_bytes,
+            kv_pool_bytes=cache_bytes, programs=program_mem,
+            mode="serve", run_id=run_id)
+        metrics.log(kind="memledger",
+                    **memledger_lib.ledger_record(ledger))
+        metrics.flush()
+        memledger_lib._atomic_write(
+            os.path.join(args.save_dir, memledger_lib.LEDGER_NAME),
+            json.dumps(ledger, indent=1))
+        log0(f"tpudist: memledger {ledger['headroom_status']}: "
+             f"{100 * ledger['headroom_fraction']:.1f}% headroom of "
+             f"{ledger['total_hbm_bytes'] / 2**20:.0f} MB HBM "
+             f"(params {params_bytes / 2**20:.1f} MB, kv_pool "
+             f"{cache_bytes / 2**20:.2f} MB, temp "
+             f"{ledger['buckets']['program_temp'] / 2**20:.1f} MB, "
+             f"{'exact' if ledger['exact'] else 'INEXACT'})")
+    except Exception as e:
+        log0(f"tpudist: memledger skipped ({e!r})")
 
     log0(f"tpudist: serve {summary['status']}: "
          f"{summary['completed']}/{summary['requests']} requests, "

@@ -42,9 +42,11 @@ import numpy as np
 from jax import lax
 
 from tpudist.config import ModelConfig
+from tpudist.engine import _arg_specs
 from tpudist.models import get_model
 from tpudist.parallel import sharding as shd
 from tpudist.serve import kvcache
+from tpudist.utils import compat
 
 
 class ServeState(NamedTuple):
@@ -125,6 +127,10 @@ class ServeEngine:
             layout=layout)
         self.prefill_traces: list = []
         self.decode_traces: list = []
+        # per-program lowering skeletons, captured at each program's
+        # first call (program_memory / the memledger's per-program
+        # memory_analysis reads these off the request clock)
+        self._programs: dict = {}
         self._prefill = jax.jit(self._prefill_body, donate_argnums=(1,))
         # k is STATIC (it is the lax.scan length): one compiled decode
         # program per ladder rung, all traced at warmup
@@ -186,6 +192,39 @@ class ServeEngine:
             remaining=state.remaining.at[slot].set(
                 jnp.where(active, rem, 0))), first
 
+    def _note_program(self, name: str, jitted, args,
+                      static_idx: Tuple[int, ...] = ()) -> None:
+        """Remember how to ``.lower()`` one pinned program: shape/
+        dtype/sharding skeletons of its first call's traced arguments
+        (``engine._arg_specs`` — no buffer kept alive, the donation
+        contract survives) with static arguments kept verbatim in
+        place. A dict-membership check per call on the hot path,
+        nothing more."""
+        if name in self._programs:
+            return
+        statics = set(static_idx)
+        dyn = iter(_arg_specs(tuple(
+            a for i, a in enumerate(args) if i not in statics)))
+        lower_args = tuple(a if i in statics else next(dyn)
+                           for i, a in enumerate(args))
+        self._programs[name] = (jitted, lower_args)
+
+    def program_memory(self) -> dict:
+        """``{program_name: memory_analysis dict}`` for every pinned
+        program the run has called — prefill, each decode-ladder rung,
+        the speculative verify. An empty dict per program on backends
+        without memory planning (the memledger records the gap as a
+        note); lowering hits jit's trace cache, so this is cheap and
+        off the request clock."""
+        out: dict = {}
+        for name, (jitted, lower_args) in sorted(self._programs.items()):
+            try:
+                out[name] = compat.memory_analysis(
+                    jitted.lower(*lower_args).compile())
+            except Exception:
+                out[name] = {}
+        return out
+
     def prefill(self, params, state: ServeState, tokens, prompt_len: int,
                 slot: int, max_new: int) -> Tuple[ServeState, jax.Array]:
         """Admit one request into ``slot``. ``tokens`` is the padded
@@ -194,9 +233,10 @@ class ServeEngine:
         state and the request's FIRST generated token (a device scalar
         — ``int()`` it to fence)."""
         tokens = jnp.asarray(tokens, jnp.int32).reshape(1, self.prompt_pad)
-        return self._prefill(params, state, tokens,
-                             jnp.int32(prompt_len), jnp.int32(slot),
-                             jnp.int32(max_new))
+        args = (params, state, tokens, jnp.int32(prompt_len),
+                jnp.int32(slot), jnp.int32(max_new))
+        self._note_program("prefill", self._prefill, args)
+        return self._prefill(*args)
 
     # ---------------------------------------------------------- decode
 
@@ -267,6 +307,8 @@ class ServeEngine:
             raise ValueError(
                 f"decode k={k} is not a warmed ladder rung "
                 f"{self.ladder}")
+        self._note_program(f"decode_k{k}", self._decode,
+                           (params, state, k), static_idx=(2,))
         return self._decode(params, state, k)
 
     # ---------------------------------------------------------- warmup
@@ -449,10 +491,11 @@ class PagedServeEngine(ServeEngine):
             page_row = self.alloc.row(slot)
         page_row = jnp.asarray(page_row, jnp.int32).reshape(
             self.spec.max_pages_per_slot)
-        return self._prefill(params, state, tokens,
-                             jnp.int32(prompt_len), jnp.int32(slot),
-                             jnp.int32(max_new), page_row,
-                             jnp.int32(shared_len))
+        args = (params, state, tokens, jnp.int32(prompt_len),
+                jnp.int32(slot), jnp.int32(max_new), page_row,
+                jnp.int32(shared_len))
+        self._note_program("prefill", self._prefill, args)
+        return self._prefill(*args)
 
     def register_prefix(self, params, state: PagedServeState,
                         prefix_tokens, prefix_len: int
@@ -544,6 +587,8 @@ class PagedServeEngine(ServeEngine):
             da = jnp.ones((self.slots,), bool)
         else:
             da = jnp.asarray(dispatch_active, bool).reshape(self.slots)
+        self._note_program(f"decode_k{k}", self._decode,
+                           (params, state, k, table, da), static_idx=(2,))
         return self._decode(params, state, k, table, da)
 
     # ---------------------------------------------------------- verify
@@ -616,7 +661,9 @@ class PagedServeEngine(ServeEngine):
             da = jnp.ones((self.slots,), bool)
         else:
             da = jnp.asarray(dispatch_active, bool).reshape(self.slots)
-        return self._verify(params, state, draft, table, da)
+        args = (params, state, draft, table, da)
+        self._note_program("verify", self._verify, args)
+        return self._verify(*args)
 
     # ---------------------------------------------------------- warmup
 

@@ -268,11 +268,54 @@ class PageAllocator:
         # how many leading POSITIONS those full pages cover
         self.shared_pages: Tuple[int, ...] = ()
         self.shared_len = 0
+        # admission page ceiling: the whole pool by default; a memory
+        # bound (set_memory_bound) lowers it when device HBM cannot
+        # actually afford every configured page beside the params and
+        # the compiled programs' scratch
+        self.page_cap = spec.pages
+        self.bound_source = "none"     # none | ledger | heuristic
+
+    def set_memory_bound(self, *, hbm_bytes: float,
+                         params_bytes: float = 0,
+                         program_temp_bytes: Optional[int] = None
+                         ) -> int:
+        """Cap admissions to the pages device HBM can actually afford.
+
+        The pool array is allocated in full either way; what this bounds
+        is how many pages admission will ever MAP — so a pool configured
+        past the device's real capacity backpressures at admission
+        (requests wait or are structurally rejected) instead of letting
+        the next allocation spike die in RESOURCE_EXHAUSTED. The margin
+        reserved beside the pool is ledger-informed when a prior run's
+        memory ledger measured the compiled programs' real scratch
+        (``program_temp_bytes``, obs.memledger); without a ledger it
+        falls back to the staging resolver's conservative
+        ``STAGING_STATE_HEADROOM x params`` guess. ``bound_source``
+        records which path won (the serve CLI logs it). Returns the
+        resulting page cap, clamped to [0, spec.pages] — shared-prefix
+        registry pages always stay admissible."""
+        from tpudist.config import STAGING_STATE_HEADROOM
+        if program_temp_bytes is not None and program_temp_bytes >= 0:
+            margin = float(params_bytes) + float(program_temp_bytes)
+            self.bound_source = "ledger"
+        else:
+            margin = STAGING_STATE_HEADROOM * float(params_bytes)
+            self.bound_source = "heuristic"
+        page_bytes = 2 * self.spec.n_layers * self.spec.page_tokens \
+            * self.spec.n_kv_heads * self.spec.head_dim \
+            * jnp.dtype(self.spec.dtype).itemsize
+        avail = float(hbm_bytes) - margin - self.spec.table_bytes
+        cap = int(avail // page_bytes) if page_bytes > 0 else 0
+        cap = max(cap, len(self.shared_pages))
+        self.page_cap = min(max(cap, 0), self.spec.pages)
+        return self.page_cap
 
     # ------------------------------------------------------- internal
 
     def _take(self) -> Optional[int]:
-        if not self.free:
+        # the memory bound caps LIVE pages, not just the free list: a
+        # pool configured past what HBM affords backpressures here
+        if not self.free or self.pages_used() >= self.page_cap:
             return None
         pg = self.free.pop(0)
         self.refcount[pg] += 1
@@ -396,11 +439,13 @@ class PageAllocator:
     def can_ever_admit(self, prompt_len: int, shared: bool) -> bool:
         """Could this admission EVER succeed, even with every slot
         freed? False means the request is structurally unservable at
-        this pool size (reject it — waiting forever would wedge the
-        run); the shared-prefix registry holds are the only permanent
-        reservation."""
+        this pool size — or at the memory bound's ledger/heuristic page
+        cap when one is set — (reject it: waiting forever would wedge
+        the run); the shared-prefix registry holds are the only
+        permanent reservation."""
         pt = self.spec.page_tokens
         need = -(-int(prompt_len) // pt)
         if shared:
             need = max(need - len(self.shared_pages), 0)
-        return need <= self.spec.pages - len(self.shared_pages)
+        usable = min(self.spec.pages, self.page_cap)
+        return need <= usable - len(self.shared_pages)

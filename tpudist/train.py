@@ -19,6 +19,7 @@ Run:  python -m tpudist.train --epochs 5 --train-batch-size 64
 
 from __future__ import annotations
 
+import json
 import os
 import sys
 import time
@@ -40,6 +41,7 @@ from tpudist.metrics import (MetricsLogger, StagingStats, StepTimer,
 from tpudist.obs import devtime as devtime_lib
 from tpudist.obs import goodput as goodput_lib
 from tpudist.obs import live as live_lib
+from tpudist.obs import memledger as memledger_lib
 from tpudist.obs import trace as trace_lib
 from tpudist.parallel import build_mesh, distributed
 
@@ -88,6 +90,32 @@ def _maybe_test_kill(epoch: int, step: int, observer=None) -> None:
             except Exception:
                 pass
         os._exit(113)
+
+
+def _prior_program_temp_bytes(save_dir) -> Optional[int]:
+    """Measured program scratch from a PRIOR run's persisted ledger.
+
+    The staging budget resolves BEFORE any program compiles, so the
+    ledger-informed margin (compiled scratch instead of the 4x-state
+    heuristic) can only come from ``<save_dir>/memledger.json`` written
+    by an earlier run against the same config — feed-forward. ``None``
+    on any miss (no dir, no file, partial ledger) falls back to the
+    heuristic; an INCOMPLETE ledger (some program's analysis missing,
+    e.g. a CPU backend without memory planning) is also a miss — an
+    under-measured margin would over-size the budget toward OOM, the
+    exact failure this path exists to prevent."""
+    if not save_dir:
+        return None
+    try:
+        with open(os.path.join(save_dir, memledger_lib.LEDGER_NAME),
+                  encoding="utf-8") as f:
+            doc = json.load(f)
+        if not doc.get("program_temp_complete"):
+            return None
+        temp = int(doc["buckets"]["program_temp"])
+        return temp if temp > 0 else None
+    except Exception:
+        return None
 
 
 def run(cfg: TrainConfig) -> float:
@@ -264,10 +292,25 @@ def run(cfg: TrainConfig) -> float:
         # staging budget: epochs that don't fit stream in double-buffered
         # slabs (sharding.plan_slabs) instead of staging whole — the
         # acceptance workload is no longer capped at what fits in HBM
-        # beside the params + opt state
+        # beside the params + opt state. The budget resolves BEFORE any
+        # program compiles, so the ledger-informed margin (the compiled
+        # programs' MEASURED scratch instead of the 4x state guess)
+        # comes from a PRIOR run's persisted ledger in the save dir —
+        # feed-forward, with the heuristic as the cold-start fallback
+        prior_temp = _prior_program_temp_bytes(cfg.save_dir)
         budget_bytes = config_lib.resolve_staging_budget_bytes(
             cfg, state_bytes=engine_lib.state_bytes_per_device(state),
-            hbm_bytes=engine_lib._device_hbm_bytes())
+            hbm_bytes=engine_lib._device_hbm_bytes(),
+            program_temp_bytes=prior_temp)
+        if budget_bytes is not None and cfg.staging_budget_mb is None \
+                and not os.environ.get("TPUDIST_STAGING_BUDGET_MB"):
+            if prior_temp is not None:
+                how = (f"ledger-informed: prior-run program_temp "
+                       f"{prior_temp / 2**20:.0f} MB")
+            else:
+                how = "heuristic 4x-state margin"
+            log0(f"tpudist: staging budget auto "
+                 f"{budget_bytes / 2**20:.0f} MB ({how})")
     else:
         superstep = None
         train_step = engine_lib.make_train_step(cfg, mesh)
@@ -650,6 +693,71 @@ def run(cfg: TrainConfig) -> float:
                 trace_spans=(trace_summary or {}).get("spans"),
                 trace_dropped=(trace_summary or {}).get("dropped"),
                 **obs_fields)
+    # program-derived HBM ledger (obs.memledger): one device's HBM
+    # partitioned EXACTLY into params / opt_state / slabs / kv_pool /
+    # program_temp / headroom / residue — static buckets from the model
+    # (state_bytes_per_device, plan_slabs), scratch from the compiled
+    # program's own memory_analysis, reconciled against the sampler's
+    # measured watermark. Advisory end to end: a backend without memory
+    # planning logs a note, never fails the run. The persisted artifact
+    # is next run's feed-forward input (_prior_program_temp_bytes).
+    ledger = None
+    try:
+        _step_fn = superstep if superstep is not None else train_step
+        _prog = "superstep" if superstep is not None else "train_step"
+        programs = {_prog: (_step_fn.memory_analysis() or {})
+                    if getattr(_step_fn, "memory_analysis", None)
+                    else {}}
+        slab_b = staging.peak_bytes
+        if superstep is not None and budget_bytes is not None:
+            # plan-derived resident slabs (x2 when double-buffered
+            # streaming) — the budget's own arithmetic, so the ledger
+            # states what the staging pipeline COMMITS to, not just
+            # what this epoch happened to touch
+            from tpudist.parallel import sharding as shd_lib
+            _p0 = epoch_plan(0)
+            _shards = max(mesh.shape["data"] * mesh.shape["fsdp"], 1)
+            _sb = max(1, _p0.bytes_per_step * ctx.process_count
+                      // _shards)
+            _sp = shd_lib.plan_slabs(_p0.n_steps, k, _sb, budget_bytes)
+            slab_b = (min(2, _sp.n_slabs) * _sp.slab_bytes
+                      if _sp.streamed else _sp.slab_bytes)
+        ledger = memledger_lib.build_ledger(
+            total_hbm_bytes=int(engine_lib._device_hbm_bytes()),
+            params_bytes=engine_lib.state_bytes_per_device(state.params),
+            opt_state_bytes=engine_lib.state_bytes_per_device(
+                state.opt_state),
+            slab_bytes=slab_b,
+            programs=programs,
+            watermark_bytes=obs_fields.get("hbm_peak_bytes"),
+            watermark_source=obs_fields.get("hbm_source"),
+            mode="train", run_id=run_id)
+    except Exception as e:
+        log0(f"tpudist: memledger skipped ({e!r})")
+    if ledger is not None:
+        metrics.log(kind="memledger",
+                    **memledger_lib.ledger_record(ledger))
+        # a pre-kill flight record must carry the last known partition
+        # — that embedded copy is what the OOM forensics CLI reads back
+        observer.last_memledger = ledger
+        if ctx.is_coordinator and cfg.save_dir:
+            try:
+                memledger_lib._atomic_write(
+                    os.path.join(cfg.save_dir, memledger_lib.LEDGER_NAME),
+                    json.dumps(ledger, indent=1))
+            except Exception:
+                pass
+        _lb = ledger["buckets"]
+        log0(f"tpudist: memledger {ledger['headroom_status']}: "
+             f"{100 * ledger['headroom_fraction']:.1f}% headroom of "
+             f"{ledger['total_hbm_bytes'] / 2**20:.0f} MB HBM "
+             f"(params {_lb['params'] / 2**20:.1f} MB, opt "
+             f"{_lb['opt_state'] / 2**20:.1f} MB, slabs "
+             f"{_lb['slabs'] / 2**20:.1f} MB, temp "
+             f"{_lb['program_temp'] / 2**20:.1f} MB, "
+             f"{'exact' if ledger['exact'] else 'INEXACT'})")
+        for n in ledger["problems"] + ledger["notes"]:
+            log0(f"tpudist: memledger note: {n}")
     # attempt-local goodput estimate (obs.goodput): the same bucket
     # math the cross-attempt ledger applies, over this attempt's own
     # records and wall — graded against the shared rules floor, fanned

@@ -206,6 +206,29 @@ def goodput_status(fraction, min_fraction: float | None = None) -> str:
     return _impl(fraction, min_fraction)
 
 
+# HBM-headroom gate (tpudist.obs.memledger): the unattributed free
+# fraction of device HBM after the ledger's buckets are carved out.
+# Aliased from the shared rules table like every other gate (env
+# override TPUDIST_HBM_HEADROOM_MIN, read at call time). Advisory, and
+# opt-in: the default floor 0.0 only breaches on an over-committed
+# device (negative headroom).
+HBM_HEADROOM_MIN = rules_lib.HBM_HEADROOM_MIN
+
+
+def hbm_headroom_status(fraction, min_fraction: float | None = None
+                        ) -> str:
+    """Three-valued HBM-headroom verdict (tpudist.obs.memledger):
+    UNGATEABLE with no ledger, else SUCCESS/FAIL by whether the free
+    fraction clears ``TPUDIST_HBM_HEADROOM_MIN``. The implementation
+    lives in obs.memledger next to the partition that produces the
+    fraction; this delegator keeps the verdict surface in one place
+    like the other gates. (Lazy import: memledger mirrors this module's
+    status vocabulary without importing it — same pattern as
+    goodput_status.)"""
+    from tpudist.obs.memledger import hbm_headroom_status as _impl
+    return _impl(fraction, min_fraction)
+
+
 # Serving SLO gates (tpudist.serve): latency-percentile ceilings plus a
 # throughput floor, graded over the serve loop's measured TTFT/ITL
 # histograms. Aliased from the shared rules table like every other gate
